@@ -1,0 +1,19 @@
+"""predictionio_tpu — a TPU-native machine-learning server framework.
+
+A ground-up, JAX/XLA-first re-design with the capabilities of Apache
+PredictionIO (reference: ``WusamX/incubator-predictionio``): an event
+server ingesting timestamped events into pluggable storage, a typed DASE
+engine framework (DataSource - Preparator - Algorithm - Serving -
+Evaluator) configured by ``engine.json``, train/deploy/eval workflows
+whose compute runs as pjit-compiled JAX programs over a TPU mesh, and a
+``pio``-compatible ops CLI.
+
+Reference layer map: SURVEY.md section 2. This package is NOT a port —
+the JVM/Spark runtime of the reference is replaced by in-process JAX
+jobs (``jax.sharding.Mesh`` + pjit replaces the Spark cluster; XLA
+collectives over ICI replace the netty shuffle).
+"""
+
+from predictionio_tpu.version import __version__
+
+__all__ = ["__version__"]

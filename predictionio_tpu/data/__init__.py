@@ -1,0 +1,6 @@
+"""Event model, storage SPI, drivers, and the event server.
+
+Mirrors the capability surface of the reference ``data/`` module
+(``data/src/main/scala/org/apache/predictionio/data`` — see SURVEY.md
+section 3.4), re-designed for a Python/JAX runtime.
+"""

@@ -1,0 +1,134 @@
+"""Entity-property aggregation and string<->int indexing.
+
+Parity with the reference's entity views
+(``data/storage/LEventAggregator.scala``, ``data/storage/PEventAggregator.scala``,
+``data/storage/BiMap.scala``): fold a stream of ``$set``/``$unset``/``$delete``
+events into the current :class:`~predictionio_tpu.data.event.PropertyMap` per
+entity, and provide the bidirectional string<->index map engine templates use
+to hand dense integer ids to the numeric compute path (on TPU the BiMap is
+what turns entity ids into row indices of sharded factor matrices).
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+from typing import Iterable, Iterator, Mapping, Sequence
+
+from predictionio_tpu.data.event import (
+    DELETE_EVENT,
+    SET_EVENT,
+    UNSET_EVENT,
+    Event,
+    PropertyMap,
+)
+
+__all__ = ["aggregate_properties", "aggregate_properties_single", "BiMap"]
+
+
+def _fold(events: Iterable[Event]) -> PropertyMap | None:
+    """Fold one entity's special events (any order) into its current state.
+
+    Later ``event_time`` wins per property; ``$delete`` erases everything
+    seen so far (events after the delete re-create the entity) — the same
+    semantics as the reference aggregator's ``dataMapAggregator``.
+    """
+    ordered = sorted(events, key=lambda e: e.event_time)
+    fields: dict[str, object] = {}
+    first: _dt.datetime | None = None
+    last: _dt.datetime | None = None
+    alive = False
+    for e in ordered:
+        if e.event == DELETE_EVENT:
+            fields.clear()
+            first = last = None
+            alive = False
+        elif e.event == SET_EVENT:
+            fields.update(e.properties.to_dict())
+            first = first or e.event_time
+            last = e.event_time
+            alive = True
+        elif e.event == UNSET_EVENT and alive:
+            # $unset on a nonexistent entity is a no-op (reference:
+            # dataMapAggregator maps over None without creating the entity).
+            for k in e.properties:
+                fields.pop(k, None)
+            last = e.event_time
+    if not alive or first is None or last is None:
+        return None
+    return PropertyMap(fields, first_updated=first, last_updated=last)
+
+
+def aggregate_properties(events: Iterable[Event]) -> dict[str, PropertyMap]:
+    """Aggregate ``$set``/``$unset``/``$delete`` events (one entity type)
+    into ``{entityId: PropertyMap}``. Non-special events are ignored.
+    """
+    by_entity: dict[str, list[Event]] = {}
+    for e in events:
+        if e.is_special:
+            by_entity.setdefault(e.entity_id, []).append(e)
+    out: dict[str, PropertyMap] = {}
+    for entity_id, evs in by_entity.items():
+        folded = _fold(evs)
+        if folded is not None:
+            out[entity_id] = folded
+    return out
+
+
+def aggregate_properties_single(events: Iterable[Event]) -> PropertyMap | None:
+    """Aggregate special events of a single entity (serving-time path)."""
+    return _fold([e for e in events if e.is_special])
+
+
+class BiMap:
+    """Immutable bidirectional map string<->int (parity: ``BiMap.scala``).
+
+    ``BiMap.string_index(keys)`` assigns dense indices ``0..n-1`` in first-seen
+    order — the bridge from entity ids to rows of dense/sharded arrays.
+    """
+
+    __slots__ = ("_forward", "_inverse")
+
+    def __init__(self, forward: Mapping[str, int]):
+        self._forward = dict(forward)
+        self._inverse = {v: k for k, v in self._forward.items()}
+        if len(self._inverse) != len(self._forward):
+            raise ValueError("BiMap values must be unique")
+
+    @classmethod
+    def string_index(cls, keys: Iterable[str]) -> "BiMap":
+        forward: dict[str, int] = {}
+        for k in keys:
+            if k not in forward:
+                forward[k] = len(forward)
+        return cls(forward)
+
+    def __getitem__(self, key: str) -> int:
+        return self._forward[key]
+
+    def get(self, key: str, default: int | None = None) -> int | None:
+        return self._forward.get(key, default)
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._forward
+
+    def __len__(self) -> int:
+        return len(self._forward)
+
+    def __iter__(self) -> Iterator[str]:
+        return iter(self._forward)
+
+    def inverse(self, index: int) -> str:
+        return self._inverse[index]
+
+    def inverse_get(self, index: int, default: str | None = None) -> str | None:
+        return self._inverse.get(index, default)
+
+    def keys(self) -> Sequence[str]:
+        return list(self._forward)
+
+    def to_dict(self) -> dict[str, int]:
+        return dict(self._forward)
+
+    @classmethod
+    def from_dict(cls, d: Mapping[str, int]) -> "BiMap":
+        return cls(d)

@@ -1,0 +1,64 @@
+"""Local-filesystem model-blob driver.
+
+Parity: ``data/storage/localfs/LocalFSModels.scala`` — model blobs as files
+under a base directory (``PATH`` property, typically
+``$PIO_FS_BASEDIR/models``). The MODELDATA default.
+"""
+
+from __future__ import annotations
+
+import os
+
+from predictionio_tpu.data.storage.base import (
+    BaseStorageClient,
+    Model,
+    ModelsRepo,
+    StorageClientConfig,
+    StorageError,
+)
+
+__all__ = ["StorageClient"]
+
+
+class _FsModels(ModelsRepo):
+    def __init__(self, base: str):
+        self._base = base
+        os.makedirs(base, exist_ok=True)
+
+    def _path(self, model_id: str) -> str:
+        safe = "".join(c if c.isalnum() or c in "-_." else "_" for c in model_id)
+        return os.path.join(self._base, f"pio_model_{safe}.bin")
+
+    def insert(self, model: Model) -> None:
+        tmp = self._path(model.id) + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(model.models)
+        os.replace(tmp, self._path(model.id))
+
+    def get(self, model_id: str) -> Model | None:
+        path = self._path(model_id)
+        if not os.path.exists(path):
+            return None
+        with open(path, "rb") as f:
+            return Model(id=model_id, models=f.read())
+
+    def delete(self, model_id: str) -> bool:
+        path = self._path(model_id)
+        if os.path.exists(path):
+            os.remove(path)
+            return True
+        return False
+
+
+class StorageClient(BaseStorageClient):
+    """Model-data driver (``TYPE=localfs``; property ``PATH`` = directory)."""
+
+    def __init__(self, config: StorageClientConfig):
+        super().__init__(config)
+        path = config.properties.get("path")
+        if not path:
+            raise StorageError("localfs driver requires a PATH property")
+        self._models = _FsModels(os.path.expanduser(path))
+
+    def get_models(self) -> ModelsRepo:
+        return self._models

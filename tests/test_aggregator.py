@@ -1,0 +1,107 @@
+"""Property-aggregation and BiMap tests (reference: LEventAggregator /
+PEventAggregator / BiMapSpec behavior)."""
+
+import datetime as dt
+
+import pytest
+
+from predictionio_tpu.data.aggregator import (
+    BiMap,
+    aggregate_properties,
+    aggregate_properties_single,
+)
+from predictionio_tpu.data.event import DataMap, Event
+
+UTC = dt.timezone.utc
+
+
+def _ev(name, entity, props=None, t=0):
+    return Event(
+        event=name, entity_type="user", entity_id=entity,
+        properties=DataMap(props or {}),
+        event_time=dt.datetime(2020, 1, 1, tzinfo=UTC) + dt.timedelta(seconds=t),
+    )
+
+
+class TestAggregate:
+    def test_set_merge_latest_wins(self):
+        props = aggregate_properties([
+            _ev("$set", "u1", {"a": 1, "b": 2}, t=0),
+            _ev("$set", "u1", {"b": 3, "c": 4}, t=10),
+        ])
+        assert props["u1"].to_dict() == {"a": 1, "b": 3, "c": 4}
+        assert props["u1"].first_updated.second == 0
+        assert props["u1"].last_updated.second == 10
+
+    def test_out_of_order_fold(self):
+        props = aggregate_properties([
+            _ev("$set", "u1", {"b": 3}, t=10),
+            _ev("$set", "u1", {"a": 1, "b": 2}, t=0),
+        ])
+        assert props["u1"].to_dict() == {"a": 1, "b": 3}
+
+    def test_unset(self):
+        props = aggregate_properties([
+            _ev("$set", "u1", {"a": 1, "b": 2}, t=0),
+            _ev("$unset", "u1", {"a": None}, t=5),
+        ])
+        assert props["u1"].to_dict() == {"b": 2}
+
+    def test_delete_erases_then_recreate(self):
+        events = [
+            _ev("$set", "u1", {"a": 1}, t=0),
+            _ev("$delete", "u1", t=5),
+        ]
+        assert aggregate_properties(events) == {}
+        events.append(_ev("$set", "u1", {"z": 9}, t=10))
+        props = aggregate_properties(events)
+        assert props["u1"].to_dict() == {"z": 9}
+
+    def test_multiple_entities_and_nonspecial_ignored(self):
+        props = aggregate_properties([
+            _ev("$set", "u1", {"a": 1}),
+            _ev("$set", "u2", {"a": 2}),
+            _ev("view", "u3", {"x": 1}),
+        ])
+        assert set(props) == {"u1", "u2"}
+
+    def test_single_entity(self):
+        pm = aggregate_properties_single([
+            _ev("$set", "u1", {"a": 1}, t=0),
+            _ev("$unset", "u1", {"a": 1}, t=1),
+            _ev("$set", "u1", {"b": 5}, t=2),
+        ])
+        assert pm is not None and pm.to_dict() == {"b": 5}
+        assert aggregate_properties_single([_ev("view", "u1")]) is None
+
+
+class TestBiMap:
+    def test_string_index_dense_and_stable(self):
+        bm = BiMap.string_index(["c", "a", "b", "a", "c"])
+        assert len(bm) == 3
+        assert bm["c"] == 0 and bm["a"] == 1 and bm["b"] == 2
+        assert bm.inverse(1) == "a"
+
+    def test_contains_get_inverse(self):
+        bm = BiMap.string_index(["x", "y"])
+        assert "x" in bm and "z" not in bm
+        assert bm.get("z") is None and bm.get("z", -1) == -1
+        assert bm.inverse_get(99) is None
+
+    def test_roundtrip_dict(self):
+        bm = BiMap.string_index(["p", "q"])
+        assert BiMap.from_dict(bm.to_dict()).to_dict() == bm.to_dict()
+
+    def test_duplicate_values_rejected(self):
+        with pytest.raises(ValueError):
+            BiMap({"a": 0, "b": 0})
+
+
+class TestReviewRegressions:
+    def test_unset_never_creates_entity(self):
+        assert aggregate_properties([_ev("$unset", "u1", {"a": 1})]) == {}
+        assert aggregate_properties([
+            _ev("$set", "u1", {"a": 1}, t=0),
+            _ev("$delete", "u1", t=1),
+            _ev("$unset", "u1", {"a": 1}, t=2),
+        ]) == {}

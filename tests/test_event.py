@@ -1,0 +1,179 @@
+"""Event model tests (parity with the reference's DataMapSpec /
+EventJson4sSupport specs — SURVEY.md section 5.1)."""
+
+import datetime as dt
+
+import pytest
+
+from predictionio_tpu.data.event import (
+    DataMap,
+    Event,
+    EventValidationError,
+    event_from_json,
+    event_to_json,
+    format_event_time,
+    parse_event_time,
+    validate_event,
+)
+
+UTC = dt.timezone.utc
+
+
+class TestDataMap:
+    def test_typed_get(self):
+        dm = DataMap({"a": 1, "b": "x", "c": 2.5, "d": [1, 2], "e": True})
+        assert dm.get_as("a", int) == 1
+        assert dm.get_as("a", float) == 1.0  # int widens to float
+        assert dm.get_as("b", str) == "x"
+        assert dm.get_as("c", float) == 2.5
+        assert dm.get_as("e", bool) is True
+        assert dm.get_double_list("d") == [1.0, 2.0]
+
+    def test_get_wrong_type_raises(self):
+        dm = DataMap({"a": "not-an-int", "b": True})
+        with pytest.raises(EventValidationError):
+            dm.get_as("a", int)
+        with pytest.raises(EventValidationError):
+            dm.get_as("b", int)  # bool is not an int here
+
+    def test_missing_and_opt(self):
+        dm = DataMap({"a": 1})
+        with pytest.raises(EventValidationError):
+            dm.get_as("zzz", int)
+        assert dm.opt("zzz") is None
+        assert dm.opt("zzz", int, 7) == 7
+        assert dm.opt("a", int) == 1
+
+    def test_require(self):
+        dm = DataMap({"a": 1, "b": 2})
+        dm.require("a", "b")
+        with pytest.raises(EventValidationError):
+            dm.require("a", "c")
+
+    def test_union_and_without(self):
+        a = DataMap({"x": 1, "y": 2})
+        b = DataMap({"y": 3, "z": 4})
+        assert a.union(b).to_dict() == {"x": 1, "y": 3, "z": 4}
+        assert a.without(["x"]).to_dict() == {"y": 2}
+
+    def test_mapping_protocol(self):
+        dm = DataMap({"a": 1})
+        assert "a" in dm and len(dm) == 1 and dict(dm) == {"a": 1}
+        assert dm == DataMap({"a": 1})
+
+
+class TestTimeCodec:
+    def test_parse_with_zone(self):
+        t = parse_event_time("2004-12-13T21:39:45.618-07:00")
+        assert t.year == 2004 and t.microsecond == 618000
+        assert t.utcoffset() == dt.timedelta(hours=-7)
+
+    def test_parse_z_and_naive(self):
+        assert parse_event_time("2020-01-02T03:04:05Z").tzinfo == UTC
+        assert parse_event_time("2020-01-02T03:04:05").utcoffset() == dt.timedelta(0)
+
+    def test_roundtrip(self):
+        s = "2014-09-09T16:17:42.937-08:00"
+        assert format_event_time(parse_event_time(s)) == s
+
+    def test_bad_time(self):
+        with pytest.raises(EventValidationError):
+            parse_event_time("not-a-time")
+        with pytest.raises(EventValidationError):
+            parse_event_time("2020-13-40T99:99:99Z")
+
+
+class TestValidation:
+    def test_plain_event_ok(self):
+        validate_event(Event(event="rate", entity_type="user", entity_id="u1",
+                             target_entity_type="item", target_entity_id="i1"))
+
+    def test_empty_fields(self):
+        with pytest.raises(EventValidationError):
+            validate_event(Event(event="", entity_type="user", entity_id="u1"))
+        with pytest.raises(EventValidationError):
+            validate_event(Event(event="rate", entity_type="", entity_id="u1"))
+        with pytest.raises(EventValidationError):
+            validate_event(Event(event="rate", entity_type="user", entity_id=""))
+
+    def test_reserved_names(self):
+        validate_event(Event(event="$set", entity_type="user", entity_id="u1",
+                             properties=DataMap({"a": 1})))
+        with pytest.raises(EventValidationError):
+            validate_event(Event(event="$bogus", entity_type="user", entity_id="u1"))
+        with pytest.raises(EventValidationError):
+            validate_event(Event(event="rate", entity_type="pio_user", entity_id="u1"))
+
+    def test_special_event_rules(self):
+        with pytest.raises(EventValidationError):  # $unset needs properties
+            validate_event(Event(event="$unset", entity_type="user", entity_id="u1"))
+        with pytest.raises(EventValidationError):  # $delete must have none
+            validate_event(Event(event="$delete", entity_type="user", entity_id="u1",
+                                 properties=DataMap({"a": 1})))
+        with pytest.raises(EventValidationError):  # $set cannot target
+            validate_event(Event(event="$set", entity_type="user", entity_id="u1",
+                                 properties=DataMap({"a": 1}),
+                                 target_entity_type="item", target_entity_id="i1"))
+
+    def test_target_entity_pairing(self):
+        with pytest.raises(EventValidationError):
+            validate_event(Event(event="rate", entity_type="user", entity_id="u1",
+                                 target_entity_type="item"))
+
+
+class TestJsonCodec:
+    def test_roundtrip(self):
+        ev = event_from_json({
+            "event": "rate",
+            "entityType": "user",
+            "entityId": "u0",
+            "targetEntityType": "item",
+            "targetEntityId": "i5",
+            "properties": {"rating": 4.5},
+            "eventTime": "2014-09-09T16:17:42.937-08:00",
+            "tags": ["a", "b"],
+            "prId": "pr1",
+        })
+        assert ev.event == "rate"
+        assert ev.properties.get_as("rating", float) == 4.5
+        j = event_to_json(ev.with_event_id("e1"))
+        assert j["eventId"] == "e1"
+        assert j["eventTime"] == "2014-09-09T16:17:42.937-08:00"
+        assert j["targetEntityId"] == "i5"
+        assert j["tags"] == ["a", "b"]
+        back = event_from_json(j)
+        assert back.event_time == ev.event_time
+        assert back.properties == ev.properties
+
+    def test_defaults(self):
+        ev = event_from_json({"event": "view", "entityType": "u", "entityId": "1"})
+        assert ev.event_time.tzinfo is not None
+        assert len(ev.properties) == 0
+        j = event_to_json(ev)
+        assert "targetEntityType" not in j
+
+    def test_missing_required(self):
+        with pytest.raises(EventValidationError):
+            event_from_json({"entityType": "u", "entityId": "1"})
+        with pytest.raises(EventValidationError):
+            event_from_json({"event": "view", "entityId": "1"})
+
+    def test_invalid_shapes(self):
+        with pytest.raises(EventValidationError):
+            event_from_json({"event": "v", "entityType": "u", "entityId": "1",
+                             "properties": "nope"})
+        with pytest.raises(EventValidationError):
+            event_from_json({"event": "v", "entityType": "u", "entityId": "1",
+                             "tags": "nope"})
+
+
+class TestReviewRegressions:
+    def test_fraction_rounds_into_next_second(self):
+        t = parse_event_time("2020-01-01T00:00:00.9999999Z")
+        assert t.second == 1 and t.microsecond == 0
+
+    def test_datamap_hash_with_unhashable_values(self):
+        dm = DataMap({"cats": ["a", "b"], "meta": {"x": 1}})
+        assert isinstance(hash(dm), int)
+        ev = Event(event="v", entity_type="u", entity_id="1", properties=dm)
+        assert isinstance(hash(ev), int)

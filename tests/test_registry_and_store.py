@@ -1,0 +1,100 @@
+"""Storage registry (env parsing, driver loading) and PEventStore/LEventStore
+tests (reference: Storage.scala config resolution + store API behavior)."""
+
+import pytest
+
+from predictionio_tpu.data.event import DataMap, Event
+from predictionio_tpu.data.storage import Storage, StorageError
+from predictionio_tpu.data.storage.base import AccessKey, App, Channel
+from predictionio_tpu.data.store import LEventStore, PEventStore, resolve_app
+
+
+class TestRegistry:
+    def test_env_resolution(self, storage_env):
+        assert Storage.repository_source_id("METADATA") == "TEST_SQLITE"
+        cfg = Storage.source_config("TEST_SQLITE")
+        assert cfg.type == "sqlite" and "path" in cfg.properties
+
+    def test_defaults_when_unconfigured(self, tmp_path):
+        Storage.configure({"PIO_FS_BASEDIR": str(tmp_path)})
+        try:
+            assert Storage.repository_source_id("METADATA") == "PIO_SQLITE"
+            assert Storage.repository_source_id("MODELDATA") == "PIO_LOCALFS"
+            cfg = Storage.source_config("PIO_SQLITE")
+            assert cfg.type == "sqlite"
+            assert cfg.properties["path"].startswith(str(tmp_path))
+        finally:
+            Storage.configure(None)
+
+    def test_unknown_source(self, storage_env):
+        with pytest.raises(StorageError):
+            Storage.source_config("NOPE")
+
+    def test_unknown_driver_type(self):
+        Storage.configure({
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "X",
+            "PIO_STORAGE_SOURCES_X_TYPE": "no_such_driver_xyz",
+        })
+        try:
+            with pytest.raises(StorageError):
+                Storage.client_for_repo("METADATA")
+        finally:
+            Storage.configure(None)
+
+    def test_client_caching_and_verify(self, storage_env):
+        c1 = Storage.client_for_repo("METADATA")
+        c2 = Storage.client_for_repo("EVENTDATA")
+        assert c1 is c2  # same source id -> same cached client
+        status = Storage.verify_all()
+        assert all(v["ok"] for v in status.values())
+
+
+@pytest.fixture()
+def seeded(storage_env):
+    apps = Storage.get_meta_data_apps()
+    app_id = apps.insert(App(0, "shop"))
+    ch_id = Storage.get_meta_data_channels().insert(Channel(0, "backtest", app_id))
+    Storage.get_meta_data_access_keys().insert(AccessKey("k1", app_id))
+    le = Storage.get_l_events()
+    le.init(app_id)
+    le.init(app_id, ch_id)
+    for i in range(4):
+        le.insert(Event(event="buy", entity_type="user", entity_id=f"u{i % 2}",
+                        target_entity_type="item", target_entity_id=f"i{i}"),
+                  app_id)
+    le.insert(Event(event="$set", entity_type="item", entity_id="i0",
+                    properties=DataMap({"category": "book"})), app_id)
+    le.insert(Event(event="view", entity_type="user", entity_id="u9"), app_id, ch_id)
+    return app_id, ch_id
+
+
+class TestStores:
+    def test_resolve_app(self, seeded):
+        app_id, ch_id = seeded
+        assert resolve_app("shop") == (app_id, None)
+        assert resolve_app("shop", "backtest") == (app_id, ch_id)
+        with pytest.raises(StorageError):
+            resolve_app("nope")
+        with pytest.raises(StorageError):
+            resolve_app("shop", "nochannel")
+
+    def test_pevent_find(self, seeded):
+        evs = list(PEventStore.find("shop", event_names=["buy"]))
+        assert len(evs) == 4
+        evs = list(PEventStore.find("shop", channel_name="backtest"))
+        assert [e.event for e in evs] == ["view"]
+
+    def test_aggregate_properties(self, seeded):
+        props = PEventStore.aggregate_properties("shop", "item")
+        assert props["i0"].get_as("category", str) == "book"
+        assert PEventStore.aggregate_properties(
+            "shop", "item", required=["missing"]) == {}
+
+    def test_levent_by_entity(self, seeded):
+        evs = LEventStore.find_by_entity("shop", "user", "u0", event_names=["buy"])
+        assert len(evs) == 2
+        # newest-first by default
+        assert evs[0].event_time >= evs[1].event_time
+        pm = LEventStore.aggregate_properties_of_entity("shop", "item", "i0")
+        assert pm is not None and pm.get_as("category", str) == "book"
+        assert LEventStore.aggregate_properties_of_entity("shop", "item", "zz") is None

@@ -1,0 +1,249 @@
+"""Storage contract suite — ONE behavioral spec run against EVERY driver.
+
+This is the reference's most important testing idea (SURVEY.md section 5.1:
+``LEventsSpec``/``PEventsSpec`` parameterized over HBase/JDBC/ES), ported:
+each fixture params over the available backends and the same assertions run
+against each.
+"""
+
+import datetime as dt
+
+import pytest
+
+from predictionio_tpu.data.event import DataMap, Event
+from predictionio_tpu.data.storage.base import (
+    AccessKey,
+    App,
+    Channel,
+    EngineInstance,
+    Model,
+    StorageClientConfig,
+)
+from predictionio_tpu.data.storage import localfs, memory, sqlite
+
+UTC = dt.timezone.utc
+APP = 7
+
+
+def _client(kind: str, tmp_path):
+    if kind == "memory":
+        return memory.StorageClient(StorageClientConfig("T", "memory"))
+    if kind == "sqlite":
+        return sqlite.StorageClient(
+            StorageClientConfig("T", "sqlite", {"path": str(tmp_path / "t.db")})
+        )
+    raise AssertionError(kind)
+
+
+@pytest.fixture(params=["memory", "sqlite"])
+def client(request, tmp_path):
+    c = _client(request.param, tmp_path)
+    yield c
+    c.close()
+
+
+def _ev(name="rate", entity="u1", target=None, t=0, props=None):
+    return Event(
+        event=name, entity_type="user", entity_id=entity,
+        target_entity_type="item" if target else None,
+        target_entity_id=target,
+        properties=DataMap(props or {}),
+        event_time=dt.datetime(2021, 6, 1, tzinfo=UTC) + dt.timedelta(seconds=t),
+    )
+
+
+class TestLEventsContract:
+    def test_insert_get_delete(self, client):
+        le = client.get_l_events()
+        le.init(APP)
+        eid = le.insert(_ev(props={"rating": 5.0}, target="i1"), APP)
+        got = le.get(eid, APP)
+        assert got is not None
+        assert got.event_id == eid
+        assert got.properties.get_as("rating", float) == 5.0
+        assert got.target_entity_id == "i1"
+        assert le.delete(eid, APP)
+        assert le.get(eid, APP) is None
+        assert not le.delete(eid, APP)
+
+    def test_find_filters(self, client):
+        le = client.get_l_events()
+        le.init(APP)
+        le.insert(_ev("view", "u1", target="i1", t=0), APP)
+        le.insert(_ev("rate", "u1", target="i2", t=10), APP)
+        le.insert(_ev("rate", "u2", target="i1", t=20), APP)
+
+        assert len(list(le.find(APP))) == 3
+        assert len(list(le.find(APP, event_names=["rate"]))) == 2
+        assert len(list(le.find(APP, entity_id="u1"))) == 2
+        assert len(list(le.find(APP, target_entity_type="item",
+                                target_entity_id="i1"))) == 2
+        base = dt.datetime(2021, 6, 1, tzinfo=UTC)
+        assert len(list(le.find(APP, start_time=base + dt.timedelta(seconds=5)))) == 2
+        assert len(list(le.find(APP, until_time=base + dt.timedelta(seconds=10)))) == 1
+        # ordering + limit + reversed
+        times = [e.event_time for e in le.find(APP)]
+        assert times == sorted(times)
+        newest = list(le.find(APP, limit=1, reversed=True))
+        assert newest[0].entity_id == "u2"
+
+    def test_channel_isolation(self, client):
+        le = client.get_l_events()
+        le.init(APP)
+        le.init(APP, 3)
+        le.insert(_ev("view", "u1"), APP)
+        le.insert(_ev("buy", "u1"), APP, 3)
+        assert [e.event for e in le.find(APP)] == ["view"]
+        assert [e.event for e in le.find(APP, 3)] == ["buy"]
+        assert le.remove(APP, 3)
+        le.init(APP, 3)
+        assert list(le.find(APP, 3)) == []
+
+    def test_insert_batch(self, client):
+        le = client.get_l_events()
+        le.init(APP)
+        ids = le.insert_batch([_ev(t=i) for i in range(5)], APP)
+        assert len(ids) == len(set(ids)) == 5
+        assert len(list(le.find(APP))) == 5
+
+
+class TestPEventsContract:
+    def test_write_find_shards(self, client):
+        pe = client.get_p_events()
+        pe.write([_ev("rate", f"u{i}", target=f"i{i}", t=i) for i in range(10)], APP)
+        allev = list(pe.find(APP))
+        assert len(allev) == 10
+        shards = [list(pe.find(APP, shard_index=s, num_shards=3)) for s in range(3)]
+        ids = sorted(e.entity_id for sh in shards for e in sh)
+        assert ids == sorted(f"u{i}" for i in range(10))
+        assert all(len(s) > 0 for s in shards)
+
+    def test_delete_all(self, client):
+        pe = client.get_p_events()
+        pe.write([_ev(t=i) for i in range(3)], APP)
+        pe.delete(APP)
+        assert list(pe.find(APP)) == []
+
+
+class TestMetadataContract:
+    def test_apps(self, client):
+        apps = client.get_apps()
+        app_id = apps.insert(App(0, "myapp", "desc"))
+        assert app_id
+        assert apps.get(app_id).name == "myapp"
+        assert apps.get_by_name("myapp").id == app_id
+        assert apps.insert(App(0, "myapp")) is None  # unique name
+        second = apps.insert(App(0, "other"))
+        assert {a.name for a in apps.get_all()} == {"myapp", "other"}
+        assert apps.update(App(app_id, "renamed", None))
+        assert apps.get_by_name("renamed") is not None
+        assert apps.delete(second)
+        assert apps.get(second) is None
+
+    def test_access_keys(self, client):
+        keys = client.get_access_keys()
+        k1 = keys.insert(AccessKey("", 1, ("rate", "view")))
+        assert k1 and keys.get(k1).events == ("rate", "view")
+        k2 = keys.insert(AccessKey("explicit-key", 2))
+        assert k2 == "explicit-key"
+        assert {k.key for k in keys.get_by_appid(1)} == {k1}
+        assert keys.update(AccessKey(k1, 1, ()))
+        assert keys.get(k1).events == ()
+        assert keys.delete(k1) and keys.get(k1) is None
+
+    def test_channels(self, client):
+        ch = client.get_channels()
+        c1 = ch.insert(Channel(0, "backtest", 1))
+        assert c1 and ch.get(c1).name == "backtest"
+        assert ch.insert(Channel(0, "backtest", 1)) is None  # dup per app
+        assert ch.insert(Channel(0, "bad name!", 1)) is None  # invalid name
+        c2 = ch.insert(Channel(0, "live", 1))
+        assert [c.id for c in ch.get_by_appid(1)] == [c1, c2]
+        assert ch.delete(c1) and ch.get(c1) is None
+
+    def test_engine_instances(self, client):
+        repo = client.get_engine_instances()
+        t0 = dt.datetime(2022, 1, 1, tzinfo=UTC)
+
+        def mk(i, status):
+            return EngineInstance(
+                id="", status=status, start_time=t0 + dt.timedelta(hours=i),
+                end_time=t0 + dt.timedelta(hours=i + 1),
+                engine_id="eng", engine_version="1", engine_variant="default",
+                engine_factory="mod:fn", batch=f"b{i}",
+                env={"K": "V"}, mesh_conf={"mesh": "2x4"},
+                algorithms_params='[{"name":"als"}]',
+            )
+
+        i1 = repo.insert(mk(0, "COMPLETED"))
+        i2 = repo.insert(mk(1, "COMPLETED"))
+        repo.insert(mk(2, "FAILED"))
+        assert repo.get(i1).env == {"K": "V"}
+        assert repo.get(i1).mesh_conf == {"mesh": "2x4"}
+        latest = repo.get_latest_completed("eng", "1", "default")
+        assert latest.id == i2
+        assert len(repo.get_completed("eng", "1", "default")) == 2
+        assert repo.get_latest_completed("eng", "2", "default") is None
+        upd = repo.get(i1).with_status("FAILED")
+        assert repo.update(upd) and repo.get(i1).status == "FAILED"
+        assert repo.delete(i1) and repo.get(i1) is None
+
+    def test_models_blob(self, client, tmp_path):
+        if type(client).__module__.endswith("sqlite"):
+            models = client.get_models()
+        else:
+            models = client.get_models()
+        blob = b"\x00\x01binary\xff" * 100
+        models.insert(Model("inst1", blob))
+        assert models.get("inst1").models == blob
+        models.insert(Model("inst1", b"v2"))  # overwrite
+        assert models.get("inst1").models == b"v2"
+        assert models.delete("inst1") and models.get("inst1") is None
+
+
+class TestLocalFsModels:
+    def test_blob_roundtrip(self, tmp_path):
+        c = localfs.StorageClient(
+            StorageClientConfig("FS", "localfs", {"path": str(tmp_path / "m")}))
+        blob = bytes(range(256)) * 10
+        c.get_models().insert(Model("abc/def", blob))  # id gets sanitized
+        assert c.get_models().get("abc/def").models == blob
+        assert c.get_models().delete("abc/def")
+        assert c.get_models().get("abc/def") is None
+
+
+class TestReviewRegressions:
+    def test_empty_event_names_matches_nothing(self, client):
+        le = client.get_l_events()
+        le.init(APP)
+        le.insert(_ev("view"), APP)
+        assert list(le.find(APP, event_names=[])) == []
+        assert len(list(le.find(APP, event_names=None))) == 1
+
+    def test_auto_id_skips_explicit_ids(self, client):
+        apps = client.get_apps()
+        a1 = apps.insert(App(0, "r1"))
+        assert apps.insert(App(a1 + 1, "r2")) == a1 + 1
+        a3 = apps.insert(App(0, "r3"))
+        assert a3 is not None and a3 not in (a1, a1 + 1)
+
+    def test_limit_zero_and_negative(self, client):
+        le = client.get_l_events()
+        le.init(APP)
+        le.insert(_ev(), APP)
+        assert list(le.find(APP, limit=0)) == []
+        assert len(list(le.find(APP, limit=-1))) == 1  # negative = unbounded
+
+    def test_update_to_duplicate_name_rejected(self, client):
+        apps = client.get_apps()
+        a1 = apps.insert(App(0, "n1"))
+        apps.insert(App(0, "n2"))
+        assert apps.update(App(a1, "n2", None)) is False
+
+    def test_microsecond_roundtrip(self, client):
+        le = client.get_l_events()
+        le.init(APP)
+        t = dt.datetime(2021, 6, 1, 12, 0, 0, 123456, tzinfo=UTC)
+        eid = le.insert(Event(event="v", entity_type="u", entity_id="1",
+                              event_time=t), APP)
+        assert le.get(eid, APP).event_time == t
