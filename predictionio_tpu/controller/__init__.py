@@ -1,0 +1,104 @@
+"""DASE controller API — the user-facing engine framework.
+
+Parity: ``core/src/main/scala/org/apache/predictionio/controller/``
+(SURVEY.md section 3.3). Engine templates import from here:
+
+    from predictionio_tpu.controller import (
+        Engine, EngineParams, DataSource, Preparator, IdentityPreparator,
+        JaxAlgorithm, LocalAlgorithm, Serving, FirstServing, Params,
+        AverageMetric, Evaluation, EngineParamsGenerator,
+    )
+"""
+
+from predictionio_tpu.controller.base import create_doer
+from predictionio_tpu.controller.components import (
+    Algorithm,
+    AverageServing,
+    DataSource,
+    FirstServing,
+    IdentityPreparator,
+    JaxAlgorithm,
+    LocalAlgorithm,
+    Preparator,
+    SanityCheck,
+    Serving,
+)
+from predictionio_tpu.controller.context import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    WorkflowContext,
+    local_context,
+    mesh_context,
+)
+from predictionio_tpu.controller.engine import (
+    Engine,
+    EngineFactory,
+    EngineParams,
+    SimpleEngine,
+    resolve_engine_factory,
+)
+from predictionio_tpu.controller.evaluation import (
+    EngineParamsGenerator,
+    Evaluation,
+    MetricEvaluator,
+    MetricEvaluatorResult,
+    MetricScores,
+)
+from predictionio_tpu.controller.metrics import (
+    AverageMetric,
+    Metric,
+    OptionAverageMetric,
+    StdevMetric,
+    SumMetric,
+    ZeroMetric,
+)
+from predictionio_tpu.controller.params import (
+    EmptyParams,
+    Params,
+    ParamsError,
+    params_from_json,
+    params_to_json,
+)
+from predictionio_tpu.controller.persistent import PersistentModel, PersistentModelManifest
+
+__all__ = [
+    "Algorithm",
+    "AverageMetric",
+    "AverageServing",
+    "DATA_AXIS",
+    "DataSource",
+    "EmptyParams",
+    "Engine",
+    "EngineFactory",
+    "EngineParams",
+    "EngineParamsGenerator",
+    "Evaluation",
+    "FirstServing",
+    "IdentityPreparator",
+    "JaxAlgorithm",
+    "LocalAlgorithm",
+    "MODEL_AXIS",
+    "Metric",
+    "MetricEvaluator",
+    "MetricEvaluatorResult",
+    "MetricScores",
+    "OptionAverageMetric",
+    "Params",
+    "ParamsError",
+    "PersistentModel",
+    "PersistentModelManifest",
+    "Preparator",
+    "SanityCheck",
+    "Serving",
+    "SimpleEngine",
+    "StdevMetric",
+    "SumMetric",
+    "WorkflowContext",
+    "ZeroMetric",
+    "create_doer",
+    "local_context",
+    "mesh_context",
+    "params_from_json",
+    "params_to_json",
+    "resolve_engine_factory",
+]

@@ -1,0 +1,233 @@
+"""User-facing DASE component base classes.
+
+Parity map (reference ``core/src/main/scala/org/apache/predictionio/controller/``):
+
+* ``PDataSource.scala`` / ``LDataSource.scala``  -> :class:`DataSource`
+* ``PPreparator.scala`` / ``LPreparator.scala`` / ``IdentityPreparator.scala``
+  -> :class:`Preparator`, :class:`IdentityPreparator`
+* ``PAlgorithm.scala`` / ``P2LAlgorithm.scala`` / ``LAlgorithm.scala``
+  -> :class:`Algorithm` (base), :class:`JaxAlgorithm`, :class:`LocalAlgorithm`
+* ``LServing.scala`` / ``FirstServing.scala`` / ``AverageServing.scala``
+  -> :class:`Serving`, :class:`FirstServing`, :class:`AverageServing`
+* ``SanityCheck.scala`` -> :class:`SanityCheck`
+
+The reference's P/P2L/L split encodes *where the model lives relative to the
+Spark cluster*. On TPU that split becomes (SURVEY.md section 8.1):
+
+* :class:`JaxAlgorithm` — ``train`` runs as pjit-compiled programs over the
+  context's mesh and returns a **pytree of arrays** (the model); ``predict``
+  is mesh-free, jit-compiled, device-resident at serving time. This covers
+  both PAlgorithm (sharded training state) and P2LAlgorithm (local serving
+  model): models are always *brought to serving* as device-local pytrees —
+  there is no "model that holds an RDD", because XLA collectives replace the
+  shuffle and the trained factors fit a serving host once gathered.
+* :class:`LocalAlgorithm` — plain numpy/python train+predict, the LAlgorithm
+  analog, for small models and tests.
+
+Every class also exposes the ``*_base`` methods the workflow layer drives
+(the collapsed Base* SPI — see :mod:`predictionio_tpu.controller.base`).
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, Callable, Generic, Sequence, TypeVar
+
+import jax
+
+from predictionio_tpu.controller.context import WorkflowContext
+from predictionio_tpu.controller.params import EmptyParams, Params
+
+__all__ = [
+    "DataSource",
+    "Preparator",
+    "IdentityPreparator",
+    "Algorithm",
+    "JaxAlgorithm",
+    "LocalAlgorithm",
+    "Serving",
+    "FirstServing",
+    "AverageServing",
+    "SanityCheck",
+    "EvalUnit",
+]
+
+TD = TypeVar("TD")  # training data
+EI = TypeVar("EI")  # evaluation info
+PD = TypeVar("PD")  # prepared data
+Q = TypeVar("Q")  # query
+P = TypeVar("P")  # predicted result
+A = TypeVar("A")  # actual result
+M = TypeVar("M")  # model
+
+#: One eval fold: (training data, eval info, [(query, actual), ...]).
+EvalUnit = tuple  # (TD, EI, list[tuple[Q, A]])
+
+
+class SanityCheck(abc.ABC):
+    """Data classes may implement this to be checked after read/prepare when
+    the workflow runs with sanity checks on (parity: ``SanityCheck.scala``)."""
+
+    @abc.abstractmethod
+    def sanity_check(self) -> None:
+        """Raise on inconsistent data."""
+
+
+class _Component:
+    """Shared plumbing: every DASE component may hold a ``Params``."""
+
+    def __init__(self, params: Params | None = None):
+        self.params: Params = params if params is not None else EmptyParams()
+
+
+class DataSource(_Component, Generic[TD, EI, Q, A]):
+    """Reads training and evaluation data from the event store
+    (parity: ``PDataSource.scala``; the L variant collapses in, since both
+    return host-side data here — device placement happens in the algorithm).
+    """
+
+    def read_training(self, ctx: WorkflowContext) -> TD:
+        raise NotImplementedError(f"{type(self).__name__} must implement read_training")
+
+    def read_eval(self, ctx: WorkflowContext) -> list[EvalUnit]:
+        """K folds of (TD, EI, [(Q, A)]) (parity: ``readEval``)."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support evaluation "
+            "(implement read_eval)"
+        )
+
+    # -- Base SPI ----------------------------------------------------------
+    def read_training_base(self, ctx: WorkflowContext) -> TD:
+        return self.read_training(ctx)
+
+    def read_eval_base(self, ctx: WorkflowContext) -> list[EvalUnit]:
+        return self.read_eval(ctx)
+
+
+class Preparator(_Component, Generic[TD, PD]):
+    """Transforms training data into algorithm-ready prepared data
+    (parity: ``PPreparator.scala``)."""
+
+    def prepare(self, ctx: WorkflowContext, training_data: TD) -> PD:
+        raise NotImplementedError(f"{type(self).__name__} must implement prepare")
+
+    def prepare_base(self, ctx: WorkflowContext, training_data: TD) -> PD:
+        return self.prepare(ctx, training_data)
+
+
+class IdentityPreparator(Preparator[TD, TD]):
+    """Passes training data through unchanged
+    (parity: ``IdentityPreparator.scala``)."""
+
+    def prepare(self, ctx: WorkflowContext, training_data: TD) -> TD:
+        return training_data
+
+
+class Algorithm(_Component, Generic[PD, M, Q, P]):
+    """Abstract algorithm: train a model, answer queries
+    (parity: the shared surface of ``P/P2L/LAlgorithm.scala``)."""
+
+    def train(self, ctx: WorkflowContext, prepared_data: PD) -> M:
+        raise NotImplementedError(f"{type(self).__name__} must implement train")
+
+    def predict(self, model: M, query: Q) -> P:
+        raise NotImplementedError(f"{type(self).__name__} must implement predict")
+
+    def batch_predict(self, model: M, queries: Sequence[tuple[int, Q]]) -> list[tuple[int, P]]:
+        """Bulk prediction for evaluation (parity: ``batchPredict``).
+        Default: loop ``predict``; JAX algorithms should override with a
+        vmapped/batched kernel."""
+        return [(idx, self.predict(model, q)) for idx, q in queries]
+
+    # -- serving lifecycle -------------------------------------------------
+    def prepare_model_for_serving(self, model: M) -> M:
+        """Hook run once at deploy time (jit warm-up, device placement).
+        Parity: the model re-hydration decisions in ``Engine.prepareDeploy``."""
+        return model
+
+    # -- Base SPI ----------------------------------------------------------
+    def train_base(self, ctx: WorkflowContext, prepared_data: PD) -> M:
+        return self.train(ctx, prepared_data)
+
+    def predict_base(self, model: Any, query: Any) -> Any:
+        return self.predict(model, query)
+
+    def batch_predict_base(
+        self, model: Any, queries: Sequence[tuple[int, Any]]
+    ) -> list[tuple[int, Any]]:
+        return self.batch_predict(model, queries)
+
+
+class JaxAlgorithm(Algorithm[PD, M, Q, P]):
+    """An algorithm whose ``train`` is a pjit-compiled program over
+    ``ctx.mesh`` and whose model is a pytree of arrays.
+
+    Contract (tpu-first, SURVEY.md section 8.1):
+
+    * ``train(ctx, pd)`` must do its heavy compute inside jitted functions
+      with shardings placed on ``ctx.mesh``; it returns a pytree whose
+      leaves are ``jax.Array`` / numpy arrays. No Python-object graphs.
+    * ``predict(model, query)`` must be cheap: python-side feature lookup +
+      a call into a jitted kernel. Use :meth:`jit_kernel` to build/memoize
+      kernels so deploy-time warm-up triggers compilation exactly once.
+    * models cross the train->serve boundary as host numpy pytrees
+      (see ``predictionio_tpu.utils.serialization``), then are device-put
+      back at deploy. This is the P2L "Spark-trained, locally-served" split
+      done the XLA way.
+    """
+
+    def __init__(self, params: Params | None = None):
+        super().__init__(params)
+        self._kernels: dict[str, Callable] = {}
+
+    def jit_kernel(self, name: str, fn: Callable, **jit_kwargs) -> Callable:
+        """Memoize ``jax.jit(fn)`` under ``name`` (one compile per process)."""
+        if name not in self._kernels:
+            self._kernels[name] = jax.jit(fn, **jit_kwargs)
+        return self._kernels[name]
+
+    def prepare_model_for_serving(self, model: M) -> M:
+        """Device-put model leaves so first query pays no H2D transfer."""
+        return jax.tree.map(jax.device_put, model)
+
+
+class LocalAlgorithm(Algorithm[PD, M, Q, P]):
+    """Plain single-host algorithm (parity: ``LAlgorithm.scala``) — numpy or
+    pure-python models, no mesh involvement."""
+
+
+class Serving(_Component, Generic[Q, P]):
+    """Combines per-algorithm predictions into the served result
+    (parity: ``LServing.scala``)."""
+
+    def supplement(self, query: Q) -> Q:
+        """Pre-process the incoming query (parity: ``supplement``)."""
+        return query
+
+    def serve(self, query: Q, predictions: Sequence[P]) -> P:
+        raise NotImplementedError(f"{type(self).__name__} must implement serve")
+
+    # -- Base SPI ----------------------------------------------------------
+    def supplement_base(self, query: Q) -> Q:
+        return self.supplement(query)
+
+    def serve_base(self, query: Q, predictions: Sequence[P]) -> P:
+        return self.serve(query, predictions)
+
+
+class FirstServing(Serving[Q, P]):
+    """Serve the first algorithm's prediction (parity: ``FirstServing.scala``)."""
+
+    def serve(self, query: Q, predictions: Sequence[P]) -> P:
+        if not predictions:
+            raise ValueError("FirstServing got no predictions")
+        return predictions[0]
+
+
+class AverageServing(Serving[Q, float]):
+    """Average numeric predictions (parity: ``AverageServing.scala``)."""
+
+    def serve(self, query: Q, predictions: Sequence[float]) -> float:
+        if not predictions:
+            raise ValueError("AverageServing got no predictions")
+        return float(sum(predictions)) / len(predictions)

@@ -1,0 +1,292 @@
+"""The Engine: a typed DASE composition plus its train/eval/deploy logic.
+
+Parity: ``core/src/main/scala/org/apache/predictionio/controller/Engine.scala``
+(``class Engine[TD,EI,PD,Q,P,A]``, ``object Engine.train/eval``,
+``makeSerializableModels``, ``prepareDeploy``, ``SimpleEngine``,
+``EngineParams``) and ``EngineFactory.scala``.
+
+An engine is data: the component *classes* plus a parallel ``EngineParams``
+carrying each component's ``Params``. The workflow layer
+(:mod:`predictionio_tpu.workflow`) instantiates and drives it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Any, Callable, Mapping, Sequence, Type
+
+from predictionio_tpu.controller.base import create_doer
+from predictionio_tpu.controller.components import (
+    Algorithm,
+    DataSource,
+    FirstServing,
+    IdentityPreparator,
+    Preparator,
+    SanityCheck,
+    Serving,
+)
+from predictionio_tpu.controller.context import WorkflowContext
+from predictionio_tpu.controller.params import EmptyParams, Params, params_from_json
+from predictionio_tpu.controller.persistent import (
+    PersistentModel,
+    PersistentModelManifest,
+    load_persistent_model,
+)
+from predictionio_tpu.utils.serialization import dumps_model, loads_model
+
+__all__ = [
+    "EngineParams",
+    "Engine",
+    "SimpleEngine",
+    "EngineFactory",
+    "resolve_engine_factory",
+]
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineParams:
+    """Per-component parameters for one engine variant
+    (parity: ``EngineParams`` in ``Engine.scala``).
+
+    ``algorithms`` is an ordered list of ``(algorithm_name, params)`` —
+    order defines prediction order into ``Serving.serve``.
+    """
+
+    datasource: Params = dataclasses.field(default_factory=EmptyParams)
+    preparator: Params = dataclasses.field(default_factory=EmptyParams)
+    algorithms: tuple = ()  # tuple[tuple[str, Params], ...]
+    serving: Params = dataclasses.field(default_factory=EmptyParams)
+
+
+class Engine:
+    """DASE composition (parity: ``class Engine`` in ``Engine.scala``)."""
+
+    def __init__(
+        self,
+        datasource_class: Type[DataSource],
+        preparator_class: Type[Preparator],
+        algorithms_class_map: Mapping[str, Type[Algorithm]],
+        serving_class: Type[Serving],
+    ):
+        if not algorithms_class_map:
+            raise ValueError("Engine needs at least one algorithm class")
+        self.datasource_class = datasource_class
+        self.preparator_class = preparator_class
+        self.algorithms_class_map = dict(algorithms_class_map)
+        self.serving_class = serving_class
+
+    # ------------------------------------------------------------------ params
+    def params_from_json(self, obj: Mapping[str, Any]) -> EngineParams:
+        """Bind an engine.json ``params`` tree to typed ``EngineParams``
+        (the ``JsonExtractor`` duty, done strictly — see
+        :func:`predictionio_tpu.controller.params.params_from_json`).
+
+        Expected shape (byte-compatible with reference engine.json)::
+
+            {"datasource": {"params": {...}},
+             "preparator": {"params": {...}},
+             "algorithms": [{"name": "als", "params": {...}}, ...],
+             "serving": {"params": {...}}}
+        """
+
+        def block(component: Any) -> Mapping[str, Any]:
+            if component is None:
+                return {}
+            return component.get("params", {}) if isinstance(component, Mapping) else {}
+
+        def params_cls(cls: type) -> type:
+            return getattr(cls, "params_class", EmptyParams)
+
+        algo_entries = obj.get("algorithms") or []
+        algorithms = []
+        for entry in algo_entries:
+            name = entry.get("name")
+            if name not in self.algorithms_class_map:
+                raise ValueError(
+                    f"engine.json names unknown algorithm '{name}'; "
+                    f"available: {sorted(self.algorithms_class_map)}"
+                )
+            cls = self.algorithms_class_map[name]
+            algorithms.append((name, params_from_json(params_cls(cls), entry.get("params", {}))))
+        if not algorithms:
+            # Default: first registered algorithm with empty params.
+            first = next(iter(self.algorithms_class_map))
+            algorithms = [(first, params_from_json(params_cls(self.algorithms_class_map[first]), {}))]
+
+        return EngineParams(
+            datasource=params_from_json(params_cls(self.datasource_class), block(obj.get("datasource"))),
+            preparator=params_from_json(params_cls(self.preparator_class), block(obj.get("preparator"))),
+            algorithms=tuple(algorithms),
+            serving=params_from_json(params_cls(self.serving_class), block(obj.get("serving"))),
+        )
+
+    # ------------------------------------------------------------------ doers
+    def _make_algorithms(self, engine_params: EngineParams) -> list[tuple[str, Algorithm]]:
+        out = []
+        for name, params in engine_params.algorithms:
+            if name not in self.algorithms_class_map:
+                raise ValueError(f"Unknown algorithm '{name}'")
+            out.append((name, create_doer(self.algorithms_class_map[name], params)))
+        return out
+
+    @staticmethod
+    def _sanity(obj: Any, enabled: bool, label: str) -> None:
+        if enabled and isinstance(obj, SanityCheck):
+            logger.info("Sanity-checking %s", label)
+            obj.sanity_check()
+
+    # ------------------------------------------------------------------ train
+    def train(
+        self,
+        ctx: WorkflowContext,
+        engine_params: EngineParams,
+        sanity_check: bool = False,
+        stop_after_read: bool = False,
+        stop_after_prepare: bool = False,
+    ) -> list[Any]:
+        """Run DASE training; returns one model per algorithm
+        (parity: ``object Engine.train``; the ``stop_after_*`` flags mirror
+        ``WorkflowParams.stopAfterRead/Prepare``)."""
+        # Instantiate algorithms first so a bad engine.json fails before the
+        # (expensive) data read — mirrors the reference's early reflection.
+        algorithms = self._make_algorithms(engine_params)
+        datasource = create_doer(self.datasource_class, engine_params.datasource)
+        td = datasource.read_training_base(ctx)
+        self._sanity(td, sanity_check, "training data")
+        if stop_after_read:
+            return []
+        preparator = create_doer(self.preparator_class, engine_params.preparator)
+        pd = preparator.prepare_base(ctx, td)
+        self._sanity(pd, sanity_check, "prepared data")
+        if stop_after_prepare:
+            return []
+        models = []
+        for name, algo in algorithms:
+            logger.info("Training algorithm '%s' (%s)", name, type(algo).__name__)
+            models.append(algo.train_base(ctx, pd))
+        return models
+
+    # ------------------------------------------------------------------ eval
+    def eval(
+        self, ctx: WorkflowContext, engine_params: EngineParams
+    ) -> list[tuple[Any, list[tuple[Any, Any, Any]]]]:
+        """Per eval fold: train on TD, batch-predict the held-out queries,
+        serve, and pair with actuals -> ``[(EI, [(Q, P, A), ...]), ...]``
+        (parity: ``object Engine.eval``)."""
+        datasource = create_doer(self.datasource_class, engine_params.datasource)
+        preparator = create_doer(self.preparator_class, engine_params.preparator)
+        serving = create_doer(self.serving_class, engine_params.serving)
+        results = []
+        for fold_index, (td, eval_info, qa_pairs) in enumerate(datasource.read_eval_base(ctx)):
+            logger.info("Evaluating fold %d (%d queries)", fold_index, len(qa_pairs))
+            pd = preparator.prepare_base(ctx, td)
+            algos = self._make_algorithms(engine_params)
+            models = [algo.train_base(ctx, pd) for _, algo in algos]
+            # Supplement once, then both predict and serve see the
+            # supplemented query — identical to the deploy path (SURVEY.md
+            # section 4.2), so eval scores reflect served behavior.
+            supplemented = [serving.supplement_base(q) for q, _ in qa_pairs]
+            indexed_queries = list(enumerate(supplemented))
+            # per-algorithm batch predictions, realigned by index
+            per_algo: list[dict[int, Any]] = []
+            for (name, algo), model in zip(algos, models):
+                preds = dict(algo.batch_predict_base(model, indexed_queries))
+                per_algo.append(preds)
+            qpa = []
+            for i, (_, a) in enumerate(qa_pairs):
+                sq = supplemented[i]
+                served = serving.serve_base(sq, [preds[i] for preds in per_algo])
+                qpa.append((sq, served, a))
+            results.append((eval_info, qpa))
+        return results
+
+    # ---------------------------------------------------------- persistence
+    def models_to_bytes(
+        self,
+        instance_id: str,
+        engine_params: EngineParams,
+        models: Sequence[Any],
+    ) -> bytes:
+        """Serialize trained models for the ``Models`` repo
+        (parity: ``Engine.makeSerializableModels``): each model is either
+
+        * a :class:`PersistentModel` that saved itself -> store its manifest;
+        * anything else -> pytree-pickled inline.
+        """
+        algos = self._make_algorithms(engine_params)
+        entries: list[tuple[str, Any]] = []
+        for (name, algo), model in zip(algos, models):
+            if isinstance(model, PersistentModel):
+                if model.save(instance_id, algo.params):
+                    entries.append(
+                        ("persistent", PersistentModelManifest(type(model).class_path()))
+                    )
+                    continue
+            entries.append(("pickle", model))
+        return dumps_model(entries)
+
+    def prepare_deploy(
+        self,
+        ctx: WorkflowContext,
+        engine_params: EngineParams,
+        instance_id: str,
+        model_blob: bytes,
+    ) -> tuple[Serving, list[tuple[Algorithm, Any]]]:
+        """Re-hydrate serving components + models from a completed train
+        (parity: ``Engine.prepareDeploy``). Runs each algorithm's
+        ``prepare_model_for_serving`` (device placement / jit warm-up)."""
+        serving = create_doer(self.serving_class, engine_params.serving)
+        algos = self._make_algorithms(engine_params)
+        entries = loads_model(model_blob)
+        if len(entries) != len(algos):
+            raise ValueError(
+                f"Model blob holds {len(entries)} models but engine params "
+                f"declare {len(algos)} algorithms"
+            )
+        pairs = []
+        for (name, algo), (kind, payload) in zip(algos, entries):
+            if kind == "persistent":
+                model = load_persistent_model(payload, instance_id, algo.params)
+            elif kind == "pickle":
+                model = payload
+            else:
+                raise ValueError(f"Unknown model entry kind '{kind}'")
+            pairs.append((algo, algo.prepare_model_for_serving(model)))
+        return serving, pairs
+
+
+class SimpleEngine(Engine):
+    """Single-datasource, single-algorithm engine with FirstServing
+    (parity: ``SimpleEngine`` in ``Engine.scala``)."""
+
+    def __init__(self, datasource_class: Type[DataSource], algorithm_class: Type[Algorithm]):
+        super().__init__(
+            datasource_class=datasource_class,
+            preparator_class=IdentityPreparator,
+            algorithms_class_map={"": algorithm_class},
+            serving_class=FirstServing,
+        )
+
+
+#: An EngineFactory is any zero-arg callable returning an Engine
+#: (parity: ``trait EngineFactory``). engine.json's ``engineFactory`` names
+#: one as ``"package.module:attr"`` (or dotted path whose last element is
+#: the attribute).
+EngineFactory = Callable[[], Engine]
+
+
+def resolve_engine_factory(path: str) -> EngineFactory:
+    """Resolve an ``engineFactory`` string to the factory callable
+    (parity: the reflective ``EngineFactory`` lookup in
+    ``core/workflow/CreateWorkflow.scala``)."""
+    from predictionio_tpu.utils.reflection import resolve_attr
+
+    obj = resolve_attr(path)
+    if isinstance(obj, Engine):
+        return lambda: obj
+    if not callable(obj):
+        raise TypeError(f"Engine factory '{path}' is not callable")
+    return obj

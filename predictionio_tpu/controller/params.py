@@ -1,0 +1,119 @@
+"""Component parameter objects.
+
+Parity: ``core/src/main/scala/org/apache/predictionio/controller/Params.scala``
+(``trait Params``, ``case object EmptyParams``) plus the JSON (de)serialization
+duties of ``core/workflow/JsonExtractor.scala`` — engine.json ``params`` blocks
+become typed Python objects here.
+
+A ``Params`` subclass is normally a ``@dataclass``; any object with an
+``__init__`` whose keyword arguments match the JSON keys also works. The
+extractor is deliberately strict: unknown JSON keys raise, so a typo'd
+``engine.json`` fails at load time, not mid-train (the reference gets this
+from case-class field matching).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import inspect
+from typing import Any, Mapping, Type, TypeVar
+
+__all__ = [
+    "Params",
+    "EmptyParams",
+    "params_from_json",
+    "params_to_json",
+    "ParamsError",
+]
+
+P = TypeVar("P", bound="Params")
+
+
+class ParamsError(ValueError):
+    """Raised when JSON params cannot be bound to a Params class."""
+
+
+class Params:
+    """Marker base class for component parameters (parity: ``trait Params``)."""
+
+    def to_json(self) -> dict[str, Any]:
+        return params_to_json(self)
+
+    @classmethod
+    def from_json(cls: Type[P], obj: Mapping[str, Any]) -> P:
+        return params_from_json(cls, obj)
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        if dataclasses.is_dataclass(self):
+            return object.__repr__(self)
+        return f"{type(self).__name__}({self.__dict__!r})"
+
+
+@dataclasses.dataclass(frozen=True)
+class EmptyParams(Params):
+    """The no-params placeholder (parity: ``case object EmptyParams``)."""
+
+
+def params_to_json(params: Any) -> dict[str, Any]:
+    """Params object -> JSON-compatible dict (inverse of :func:`params_from_json`)."""
+    if params is None or isinstance(params, EmptyParams):
+        return {}
+    if dataclasses.is_dataclass(params) and not isinstance(params, type):
+        return dataclasses.asdict(params)
+    if hasattr(params, "__dict__"):
+        return {k: v for k, v in vars(params).items() if not k.startswith("_")}
+    raise ParamsError(f"Cannot serialize params of type {type(params).__name__}")
+
+
+def params_from_json(cls: Type[P], obj: Mapping[str, Any] | None) -> P:
+    """Bind a JSON object to a Params class, strictly.
+
+    * dataclass: fields matched by name; missing fields must have defaults.
+    * plain class: keyword arguments of ``__init__``.
+    * unknown keys raise :class:`ParamsError`.
+    """
+    obj = dict(obj or {})
+    if cls is EmptyParams or cls is Params:
+        if obj:
+            raise ParamsError(f"{cls.__name__} accepts no parameters, got {sorted(obj)}")
+        return EmptyParams()  # type: ignore[return-value]
+
+    if dataclasses.is_dataclass(cls):
+        fields = {f.name: f for f in dataclasses.fields(cls) if f.init}
+        names = set(fields)
+        # Reconstruct nested dataclass fields (params_to_json deep-converts
+        # via asdict, so the round-trip must deep-bind too).
+        try:
+            import typing
+
+            hints = typing.get_type_hints(cls)
+        except Exception:
+            hints = {}
+        for key, value in list(obj.items()):
+            hint = hints.get(key)
+            if (
+                hint is not None
+                and isinstance(value, Mapping)
+                and dataclasses.is_dataclass(hint)
+                and isinstance(hint, type)
+            ):
+                obj[key] = params_from_json(hint, value)
+    else:
+        sig = inspect.signature(cls.__init__)
+        names = {n for n in sig.parameters if n != "self"}
+        if any(
+            p.kind == inspect.Parameter.VAR_KEYWORD
+            for p in sig.parameters.values()
+        ):
+            return cls(**obj)
+
+    unknown = set(obj) - names
+    if unknown:
+        raise ParamsError(
+            f"Unknown parameter(s) {sorted(unknown)} for {cls.__name__}; "
+            f"accepted: {sorted(names)}"
+        )
+    try:
+        return cls(**obj)
+    except TypeError as e:
+        raise ParamsError(f"Cannot construct {cls.__name__} from {obj!r}: {e}") from e

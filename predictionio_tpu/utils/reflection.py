@@ -1,0 +1,30 @@
+"""Import-path resolution shared by the reflective entry points.
+
+Parity: the reference resolves ``engineFactory`` / ``PersistentModelLoader``
+class names via JVM reflection (``core/workflow/CreateWorkflow.scala``,
+``core/controller/PersistentModel.scala``); here a path is either
+``"package.module:Qualified.Name"`` or a plain dotted path whose last
+segment is the attribute.
+"""
+
+from __future__ import annotations
+
+import importlib
+from typing import Any
+
+__all__ = ["resolve_attr"]
+
+
+def resolve_attr(path: str) -> Any:
+    """Resolve ``module:qualname`` (preferred) or ``module.attr`` to an object."""
+    if ":" in path:
+        module_name, _, qualname = path.partition(":")
+    else:
+        module_name, _, qualname = path.rpartition(".")
+        if not module_name:
+            raise ValueError(f"Cannot resolve import path '{path}'")
+    module = importlib.import_module(module_name)
+    obj: Any = module
+    for part in qualname.split("."):
+        obj = getattr(obj, part)
+    return obj

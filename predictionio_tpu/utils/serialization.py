@@ -1,0 +1,48 @@
+"""Model blob (de)serialization across the train->serve boundary.
+
+Parity: the reference java-serializes P2L/L models into the ``Models`` repo
+(``core/controller/Engine.scala`` ``makeSerializableModels``,
+``data/storage/Models.scala``). Here models are pytrees of arrays (JAX
+algorithms) or arbitrary picklable Python objects (local algorithms).
+
+``jax.Array`` leaves are converted to numpy before pickling — a committed
+device buffer must not be baked into a blob (it pins a device and an
+addressable-shard layout that the serving host may not have). Deploy-time
+re-placement is the algorithm's ``prepare_model_for_serving`` hook.
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+from typing import Any
+
+import jax
+import numpy as np
+
+__all__ = ["dumps_model", "loads_model"]
+
+_MAGIC = b"PIOTPU1\x00"
+
+
+def _to_host(x: Any) -> Any:
+    if isinstance(x, jax.Array):
+        return np.asarray(x)
+    return x
+
+
+def dumps_model(model: Any) -> bytes:
+    """Pytree/object -> bytes. jax arrays become numpy arrays."""
+    host_model = jax.tree.map(_to_host, model)
+    buf = io.BytesIO()
+    buf.write(_MAGIC)
+    pickle.dump(host_model, buf, protocol=pickle.HIGHEST_PROTOCOL)
+    return buf.getvalue()
+
+
+def loads_model(blob: bytes) -> Any:
+    """Inverse of :func:`dumps_model`; leaves stay numpy until the algorithm's
+    ``prepare_model_for_serving`` places them on device."""
+    if not blob.startswith(_MAGIC):
+        raise ValueError("Not a predictionio_tpu model blob (bad magic)")
+    return pickle.loads(blob[len(_MAGIC):])

@@ -1,0 +1,123 @@
+"""Fake DASE components for workflow tests — the reference's ``Engine0``
+pattern (SURVEY.md section 5.1): trivial integer-typed TD/PD/Q/P components
+so engine/workflow wiring can be tested without real data or devices."""
+
+from __future__ import annotations
+
+import dataclasses
+
+from predictionio_tpu.controller import (
+    DataSource,
+    Engine,
+    EngineParams,
+    FirstServing,
+    IdentityPreparator,
+    LocalAlgorithm,
+    Params,
+    Preparator,
+    SanityCheck,
+    Serving,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class DSParams(Params):
+    base: int = 10
+
+
+@dataclasses.dataclass(frozen=True)
+class AlgoParams(Params):
+    mult: int = 2
+
+
+@dataclasses.dataclass
+class TD0(SanityCheck):
+    value: int
+    poisoned: bool = False
+
+    def sanity_check(self) -> None:
+        if self.poisoned:
+            raise ValueError("poisoned training data")
+
+
+class DataSource0(DataSource):
+    params_class = DSParams
+
+    def read_training(self, ctx):
+        return TD0(self.params.base)
+
+    def read_eval(self, ctx):
+        # two folds; actual = query + base
+        folds = []
+        for fold in range(2):
+            qa = [(q, q + self.params.base) for q in range(3)]
+            folds.append((TD0(self.params.base), {"fold": fold}, qa))
+        return folds
+
+
+class Preparator0(Preparator):
+    def prepare(self, ctx, td):
+        return td.value + 1  # PD = int
+
+
+class Algo0(LocalAlgorithm):
+    params_class = AlgoParams
+
+    def train(self, ctx, pd):
+        return pd * self.params.mult  # model = int
+
+    def predict(self, model, query):
+        return model + query
+
+
+class ServingSum(Serving):
+    def serve(self, query, predictions):
+        return sum(predictions)
+
+
+#: store for PersistentModel0 (stands in for a checkpoint directory)
+PERSISTED: dict[str, int] = {}
+
+
+from predictionio_tpu.controller import PersistentModel  # noqa: E402
+
+
+class PersistentModel0(PersistentModel):
+    """Module-level persistent model so its class_path is resolvable."""
+
+    def __init__(self, value: int):
+        self.value = value
+
+    def save(self, instance_id, params):
+        PERSISTED[instance_id] = self.value
+        return True
+
+    @classmethod
+    def load(cls, instance_id, params):
+        return cls(PERSISTED[instance_id] + 100)
+
+
+class PersistentAlgo0(LocalAlgorithm):
+    params_class = AlgoParams
+
+    def train(self, ctx, pd):
+        return PersistentModel0(pd)
+
+    def predict(self, model, query):
+        return model.value + query
+
+
+def engine0() -> Engine:
+    return Engine(
+        datasource_class=DataSource0,
+        preparator_class=Preparator0,
+        algorithms_class_map={"a0": Algo0, "a1": Algo0},
+        serving_class=ServingSum,
+    )
+
+
+def simple_params(mult_a0: int = 2, mult_a1: int = 3, base: int = 10) -> EngineParams:
+    return EngineParams(
+        datasource=DSParams(base=base),
+        algorithms=(("a0", AlgoParams(mult=mult_a0)), ("a1", AlgoParams(mult=mult_a1))),
+    )

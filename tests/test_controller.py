@@ -1,0 +1,354 @@
+"""Controller-layer tests: params binding, doers, Engine train/eval,
+model persistence round-trip, metrics, MetricEvaluator ranking.
+
+Mirrors the reference's core test strategy (SURVEY.md section 5.1):
+EngineSuite-style wiring tests against fake DASE components."""
+
+import dataclasses
+
+import pytest
+
+from predictionio_tpu.controller import (
+    AverageMetric,
+    EmptyParams,
+    EngineParams,
+    FirstServing,
+    MetricEvaluator,
+    Params,
+    ParamsError,
+    PersistentModel,
+    SumMetric,
+    ZeroMetric,
+    create_doer,
+    local_context,
+    params_from_json,
+    resolve_engine_factory,
+)
+from predictionio_tpu.controller.components import AverageServing
+
+from fake_dase import (
+    Algo0,
+    AlgoParams,
+    DataSource0,
+    DSParams,
+    engine0,
+    simple_params,
+)
+
+
+# ---------------------------------------------------------------- params
+
+
+@dataclasses.dataclass(frozen=True)
+class MyParams(Params):
+    rank: int = 8
+    reg: float = 0.1
+
+
+class TestParams:
+    def test_bind_dataclass(self):
+        p = params_from_json(MyParams, {"rank": 16})
+        assert p.rank == 16 and p.reg == 0.1
+
+    def test_unknown_key_raises(self):
+        with pytest.raises(ParamsError, match="Unknown parameter"):
+            params_from_json(MyParams, {"rnk": 16})
+
+    def test_empty_params(self):
+        assert isinstance(params_from_json(EmptyParams, {}), EmptyParams)
+        with pytest.raises(ParamsError):
+            params_from_json(EmptyParams, {"x": 1})
+
+    def test_round_trip(self):
+        p = MyParams(rank=4, reg=0.5)
+        assert params_from_json(MyParams, p.to_json()) == p
+
+    def test_nested_dataclass_round_trip(self):
+        @dataclasses.dataclass(frozen=True)
+        class Opt(Params):
+            lr: float = 0.01
+
+        @dataclasses.dataclass(frozen=True)
+        class Outer(Params):
+            rank: int = 8
+            opt: Opt = dataclasses.field(default_factory=Opt)
+
+        p = Outer(rank=2, opt=Opt(lr=0.5))
+        restored = params_from_json(Outer, p.to_json())
+        assert restored == p
+        assert restored.opt.lr == 0.5  # a real Opt, not a dict
+
+
+class TestCreateDoer:
+    def test_with_params(self):
+        algo = create_doer(Algo0, AlgoParams(mult=5))
+        assert algo.params.mult == 5
+
+    def test_zero_arg_component(self):
+        class NoParams:
+            pass
+
+        assert isinstance(create_doer(NoParams), NoParams)
+
+    def test_params_to_no_params_component_raises(self):
+        class NoParams:
+            pass
+
+        with pytest.raises(TypeError):
+            create_doer(NoParams, MyParams())
+
+
+# ---------------------------------------------------------------- engine
+
+
+class TestEngineTrain:
+    def test_train_returns_one_model_per_algorithm(self):
+        models = engine0().train(local_context(), simple_params())
+        # pd = 10+1; models = pd*2, pd*3
+        assert models == [22, 33]
+
+    def test_sanity_check_runs(self):
+        class PoisonDS(DataSource0):
+            def read_training(self, ctx):
+                td = super().read_training(ctx)
+                td.poisoned = True
+                return td
+
+        eng = engine0()
+        eng.datasource_class = PoisonDS
+        with pytest.raises(ValueError, match="poisoned"):
+            eng.train(local_context(), simple_params(), sanity_check=True)
+        # without sanity flag it trains fine
+        assert eng.train(local_context(), simple_params()) == [22, 33]
+
+    def test_stop_after_read(self):
+        assert engine0().train(local_context(), simple_params(), stop_after_read=True) == []
+
+    def test_unknown_algorithm_raises(self):
+        ep = EngineParams(algorithms=(("nope", EmptyParams()),))
+        with pytest.raises(ValueError, match="Unknown algorithm"):
+            engine0().train(local_context(), ep)
+
+
+class TestEngineEval:
+    def test_eval_shape_and_serving_blend(self):
+        results = engine0().eval(local_context(), simple_params())
+        assert len(results) == 2  # two folds
+        ei, qpa = results[0]
+        assert ei == {"fold": 0}
+        # model_a0 = 22, model_a1 = 33; serving sums: p = (22+q)+(33+q)
+        for q, p, a in qpa:
+            assert p == 55 + 2 * q
+            assert a == q + 10
+
+    def test_eval_serves_supplemented_query(self):
+        from predictionio_tpu.controller import Serving
+
+        class SupplServing(Serving):
+            def supplement(self, query):
+                return {"q": query, "extra": 100}
+
+            def serve(self, query, predictions):
+                # serve must see what supplement produced
+                return predictions[0] + query["extra"]
+
+        class DictAlgo(Algo0):
+            def predict(self, model, query):
+                return model + query["q"]
+
+        eng = engine0()
+        eng.serving_class = SupplServing
+        eng.algorithms_class_map = {"a0": DictAlgo}
+        ep = EngineParams(datasource=DSParams(), algorithms=(("a0", AlgoParams()),))
+        results = eng.eval(local_context(), ep)
+        _, qpa = results[0]
+        for sq, p, a in qpa:
+            assert p == 22 + sq["q"] + 100
+
+
+class TestModelPersistence:
+    def test_pickle_round_trip(self):
+        ctx = local_context()
+        eng = engine0()
+        ep = simple_params()
+        models = eng.train(ctx, ep)
+        blob = eng.models_to_bytes("inst-1", ep, models)
+        serving, pairs = eng.prepare_deploy(ctx, ep, "inst-1", blob)
+        assert [m for _, m in pairs] == models
+        q = 7
+        preds = [algo.predict_base(m, q) for algo, m in pairs]
+        assert serving.serve_base(q, preds) == 55 + 2 * q
+
+    def test_persistent_model_path(self, tmp_path):
+        from fake_dase import PERSISTED, PersistentAlgo0
+
+        PERSISTED.clear()
+        saved = PERSISTED
+        eng = engine0()
+        eng.algorithms_class_map = {"a0": PersistentAlgo0}
+        ep = EngineParams(
+            datasource=DSParams(), algorithms=(("a0", AlgoParams()),)
+        )
+        ctx = local_context()
+        models = eng.train(ctx, ep)
+        blob = eng.models_to_bytes("inst-2", ep, models)
+        assert saved == {"inst-2": 11}
+        eng.serving_class = FirstServing
+        serving, pairs = eng.prepare_deploy(ctx, ep, "inst-2", blob)
+        (algo, model), = pairs
+        assert model.value == 111  # loaded, not pickled
+
+    def test_blob_algorithm_count_mismatch(self):
+        ctx = local_context()
+        eng = engine0()
+        ep = simple_params()
+        blob = eng.models_to_bytes("i", ep, eng.train(ctx, ep))
+        short = EngineParams(datasource=DSParams(), algorithms=(("a0", AlgoParams()),))
+        with pytest.raises(ValueError, match="declare 1 algorithms"):
+            eng.prepare_deploy(ctx, short, "i", blob)
+
+
+class TestEngineJsonParams:
+    def test_params_from_engine_json(self):
+        obj = {
+            "datasource": {"params": {"base": 20}},
+            "algorithms": [
+                {"name": "a0", "params": {"mult": 7}},
+                {"name": "a1", "params": {}},
+            ],
+        }
+        ep = engine0().params_from_json(obj)
+        assert ep.datasource == DSParams(base=20)
+        assert ep.algorithms[0] == ("a0", AlgoParams(mult=7))
+        assert ep.algorithms[1] == ("a1", AlgoParams(mult=2))
+
+    def test_default_algorithm_when_none_listed(self):
+        ep = engine0().params_from_json({})
+        assert ep.algorithms == (("a0", AlgoParams()),)
+
+    def test_unknown_algo_name(self):
+        with pytest.raises(ValueError, match="unknown algorithm"):
+            engine0().params_from_json({"algorithms": [{"name": "zzz"}]})
+
+
+def test_resolve_engine_factory():
+    factory = resolve_engine_factory("fake_dase:engine0")
+    eng = factory()
+    assert eng.train(local_context(), simple_params()) == [22, 33]
+
+
+# ---------------------------------------------------------------- serving
+
+
+class TestServing:
+    def test_first_serving(self):
+        assert FirstServing().serve({}, [3, 4]) == 3
+
+    def test_average_serving(self):
+        assert AverageServing().serve({}, [2.0, 4.0]) == 3.0
+
+    def test_empty_predictions_raise(self):
+        with pytest.raises(ValueError):
+            FirstServing().serve({}, [])
+
+
+# ---------------------------------------------------------------- metrics
+
+
+class MAE(AverageMetric):
+    def calculate_unit(self, q, p, a):
+        return -abs(p - a)
+
+
+class TestMetrics:
+    def _eval_data(self):
+        return [
+            ({}, [(0, 1.0, 1.0), (1, 2.0, 4.0)]),
+            ({}, [(2, 3.0, 3.0)]),
+        ]
+
+    def test_average_metric_pools_folds(self):
+        assert MAE().calculate(local_context(), self._eval_data()) == pytest.approx(-2.0 / 3)
+
+    def test_sum_and_zero(self):
+        class S(SumMetric):
+            def calculate_unit(self, q, p, a):
+                return p
+
+        assert S().calculate(local_context(), self._eval_data()) == 6.0
+        assert ZeroMetric().calculate(local_context(), self._eval_data()) == 0.0
+
+    def test_none_unit_raises_everywhere_except_option(self):
+        from predictionio_tpu.controller import OptionAverageMetric, StdevMetric
+
+        class NoneUnit:
+            def calculate_unit(self, q, p, a):
+                return None if q == 1 else 1.0
+
+        for base in (AverageMetric, SumMetric, StdevMetric):
+            M = type("M", (NoneUnit, base), {})
+            with pytest.raises(ValueError, match="returned None"):
+                M().calculate(local_context(), self._eval_data())
+        MOpt = type("MOpt", (NoneUnit, OptionAverageMetric), {})
+        assert MOpt().calculate(local_context(), self._eval_data()) == 1.0
+
+
+class TestMetricEvaluator:
+    def test_ranks_candidates(self, tmp_path):
+        out = tmp_path / "best.json"
+        evaluator = MetricEvaluator(MAE(), other_metrics=[ZeroMetric()], output_path=str(out))
+        # mult=1 gives model pd*1=11; predict 11+q; actual q+10 -> error 1
+        # mult=0 would give error |q - (q+10)| = 10... use candidates 1 vs 5
+        candidates = [
+            EngineParams(datasource=DSParams(), algorithms=(("a0", AlgoParams(mult=5)),)),
+            EngineParams(datasource=DSParams(), algorithms=(("a0", AlgoParams(mult=1)),)),
+        ]
+        eng = engine0()
+        eng.serving_class = FirstServing
+        result = evaluator.evaluate_base(local_context(), eng, candidates)
+        assert result.best_index == 1
+        assert result.best_engine_params is candidates[1]
+        assert result.best_score.score == pytest.approx(-1.0)
+        assert "BEST" in result.leaderboard()
+        assert result.ranking == (1, 0)
+        assert out.exists()
+
+    def test_nan_candidate_never_wins(self):
+        from predictionio_tpu.controller import OptionAverageMetric
+
+        class MaybeMAE(OptionAverageMetric):
+            def calculate_unit(self, q, p, a):
+                # first candidate (mult=0 -> model 0, predictions = q)
+                # produces huge errors; make its units all None instead
+                return None if p == a - 10 else -abs(p - a)
+
+        candidates = [
+            EngineParams(datasource=DSParams(), algorithms=(("a0", AlgoParams(mult=0)),)),
+            EngineParams(datasource=DSParams(), algorithms=(("a0", AlgoParams(mult=1)),)),
+        ]
+        eng = engine0()
+        eng.serving_class = FirstServing
+        result = MetricEvaluator(MaybeMAE()).evaluate_base(local_context(), eng, candidates)
+        # candidate 0 scores NaN (all units None) and must not be best
+        assert result.best_index == 1
+        assert result.ranking == (1, 0)
+
+    def test_inverted_ordering_leaderboard(self):
+        class LowerBetter(AverageMetric):
+            def calculate_unit(self, q, p, a):
+                return abs(p - a)
+
+            def compare(self, a, b):
+                return (a < b) - (a > b)
+
+        candidates = [
+            EngineParams(datasource=DSParams(), algorithms=(("a0", AlgoParams(mult=5)),)),
+            EngineParams(datasource=DSParams(), algorithms=(("a0", AlgoParams(mult=1)),)),
+        ]
+        eng = engine0()
+        eng.serving_class = FirstServing
+        result = MetricEvaluator(LowerBetter()).evaluate_base(local_context(), eng, candidates)
+        assert result.best_index == 1  # lowest error
+        board = result.leaderboard()
+        first_line = board.splitlines()[1]
+        assert "BEST" in first_line and "candidate[1]" in first_line
