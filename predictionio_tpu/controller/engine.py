@@ -92,10 +92,21 @@ class Engine:
              "serving": {"params": {...}}}
         """
 
-        def block(component: Any) -> Mapping[str, Any]:
+        def block(component: Any, label: str) -> Mapping[str, Any]:
+            """Extract a component's ``params`` block, strictly: stray keys
+            (e.g. params written without the ``params`` wrapper) raise
+            instead of silently training with defaults."""
             if component is None:
                 return {}
-            return component.get("params", {}) if isinstance(component, Mapping) else {}
+            if not isinstance(component, Mapping):
+                raise ValueError(f"engine.json '{label}' must be an object")
+            stray = set(component) - {"params", "name"}
+            if stray:
+                raise ValueError(
+                    f"engine.json '{label}' has unexpected key(s) {sorted(stray)}; "
+                    "component params belong under a 'params' block"
+                )
+            return component.get("params", {})
 
         def params_cls(cls: type) -> type:
             return getattr(cls, "params_class", EmptyParams)
@@ -103,24 +114,32 @@ class Engine:
         algo_entries = obj.get("algorithms") or []
         algorithms = []
         for entry in algo_entries:
-            name = entry.get("name")
+            name = entry.get("name") if isinstance(entry, Mapping) else None
             if name not in self.algorithms_class_map:
                 raise ValueError(
                     f"engine.json names unknown algorithm '{name}'; "
                     f"available: {sorted(self.algorithms_class_map)}"
                 )
             cls = self.algorithms_class_map[name]
-            algorithms.append((name, params_from_json(params_cls(cls), entry.get("params", {}))))
+            algorithms.append(
+                (name, params_from_json(params_cls(cls), block(entry, f"algorithms[{name}]")))
+            )
         if not algorithms:
             # Default: first registered algorithm with empty params.
             first = next(iter(self.algorithms_class_map))
             algorithms = [(first, params_from_json(params_cls(self.algorithms_class_map[first]), {}))]
 
         return EngineParams(
-            datasource=params_from_json(params_cls(self.datasource_class), block(obj.get("datasource"))),
-            preparator=params_from_json(params_cls(self.preparator_class), block(obj.get("preparator"))),
+            datasource=params_from_json(
+                params_cls(self.datasource_class), block(obj.get("datasource"), "datasource")
+            ),
+            preparator=params_from_json(
+                params_cls(self.preparator_class), block(obj.get("preparator"), "preparator")
+            ),
             algorithms=tuple(algorithms),
-            serving=params_from_json(params_cls(self.serving_class), block(obj.get("serving"))),
+            serving=params_from_json(
+                params_cls(self.serving_class), block(obj.get("serving"), "serving")
+            ),
         )
 
     # ------------------------------------------------------------------ doers
@@ -217,6 +236,11 @@ class Engine:
         * anything else -> pytree-pickled inline.
         """
         algos = self._make_algorithms(engine_params)
+        if len(models) != len(algos):
+            raise ValueError(
+                f"Got {len(models)} models for {len(algos)} algorithms; "
+                "models must align 1:1 with engine_params.algorithms"
+            )
         entries: list[tuple[str, Any]] = []
         for (name, algo), model in zip(algos, models):
             if isinstance(model, PersistentModel):

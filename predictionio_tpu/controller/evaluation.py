@@ -12,6 +12,7 @@ them, and reports a leaderboard with the best params.
 from __future__ import annotations
 
 import dataclasses
+import functools
 import json
 from typing import Sequence
 
@@ -106,6 +107,7 @@ class MetricEvaluatorResult:
             "bestScore": {"score": self.best_score.score, "otherScores": list(self.best_score.other_scores)},
             "bestEngineParams": ep_json(self.best_engine_params),
             "bestIdx": self.best_index,
+            "ranking": list(self.ranking),
             "metricHeader": self.metric_header,
             "otherMetricHeaders": list(self.other_metric_headers),
             "engineParamsScores": [
@@ -159,15 +161,12 @@ class MetricEvaluator:
                 return b_nan and not a_nan
             return self.metric.compare(a, b) > 0
 
-        ranking = list(range(len(scored)))
-        # insertion sort by `better` (tiny N; avoids cmp_to_key import churn)
-        for i in range(1, len(ranking)):
-            k = ranking[i]
-            j = i - 1
-            while j >= 0 and better(k, ranking[j]):
-                ranking[j + 1] = ranking[j]
-                j -= 1
-            ranking[j + 1] = k
+        ranking = sorted(
+            range(len(scored)),
+            key=functools.cmp_to_key(
+                lambda i, j: -1 if better(i, j) else (1 if better(j, i) else 0)
+            ),
+        )
         best_index = ranking[0]
         result = MetricEvaluatorResult(
             best_score=scored[best_index][1],

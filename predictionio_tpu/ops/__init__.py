@@ -1,0 +1,9 @@
+"""TPU compute ops — the replacement for the reference's MLlib dependency.
+
+The reference delegates all ML math to Spark MLlib (ALS for the
+Recommendation/Similar-Product/E-Commerce templates, NaiveBayes for
+Classification — reached via the template repos, SURVEY.md section 3.8).
+Here those kernels are first-class, implemented as jit/pjit-compiled JAX
+programs designed for the MXU: batched einsums + batched Cholesky solves,
+static shapes via bucketed padding, factors sharded over the device mesh.
+"""

@@ -1,0 +1,383 @@
+"""Alternating Least Squares, TPU-native.
+
+Replaces Spark MLlib's ``org.apache.spark.ml.recommendation.ALS``, the
+training kernel behind the reference's Recommendation / Similar-Product /
+E-Commerce templates (reached from ``PAlgorithm.train`` — see SURVEY.md
+sections 3.9, 8.1). Nothing here is a port: MLlib's block-partitioned
+shuffle becomes sharded dense compute + XLA collectives, following the
+ALX recipe (PAPERS.md — "ALX: Large Scale Matrix Factorization on TPUs"):
+
+* **Bucketed padding** — each row's ragged rating list is padded into one
+  of a few fixed widths, so every step is a static-shape batched einsum
+  the MXU can tile (no data-dependent shapes under jit).
+* **Batched normal equations** — per row ``A x = b`` with
+  ``A = Qᵀ W Q + λI`` built by ``[B,L,K]×[B,L,K] -> [B,K,K]`` einsums
+  (MXU work) and solved by batched Cholesky.
+* **Mesh sharding** — bucket rows are sharded over the ``data`` axis of
+  the mesh; the opposite-side factor matrix is replicated (it is O(N·K),
+  small next to the ratings), so the only collective is the all-gather
+  GSPMD inserts when scattering solved rows back — riding ICI, replacing
+  MLlib's netty shuffle.
+
+Supports MLlib's two objectives:
+
+* **explicit** — squared error on observed ratings with ALS-WR
+  regularization (λ scaled by each row's rating count, MLlib default).
+* **implicit** (Hu-Koren-Volinsky) — confidence ``c = 1 + α·|r|``,
+  preference ``p = [r > 0]``, with the shared ``YᵀY`` Gramian computed
+  once per half-sweep.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, NamedTuple, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+__all__ = [
+    "ALSConfig",
+    "ALSFactors",
+    "BucketedRatings",
+    "build_buckets",
+    "train_als",
+    "als_sweep",
+    "predict_scores",
+    "top_k_items",
+]
+
+_DEFAULT_BUCKET_WIDTHS = (8, 32, 128, 512, 2048, 8192, 32768)
+
+
+@dataclasses.dataclass(frozen=True)
+class ALSConfig:
+    """Hyperparameters (parity: MLlib ``ALS`` params ``rank``, ``maxIter``,
+    ``regParam``, ``implicitPrefs``, ``alpha``, ``seed``)."""
+
+    rank: int = 10
+    iterations: int = 10
+    reg: float = 0.1
+    implicit: bool = False
+    alpha: float = 1.0
+    seed: int = 0
+    #: pad rank up to a multiple of this for MXU-friendly K (0 = exact rank)
+    rank_pad_multiple: int = 0
+
+
+class ALSFactors(NamedTuple):
+    """The model: dense factor matrices. Row ``num_rows`` of each is a
+    zero sentinel used as the scatter target for padding (stripped by
+    :func:`train_als` before returning)."""
+
+    user: jax.Array  # [num_users(+1), K]
+    item: jax.Array  # [num_items(+1), K]
+
+
+class _Bucket(NamedTuple):
+    row_id: Any  # [B] int32 — sentinel = num_rows for padding rows
+    idx: Any  # [B, L] int32 — column indices into the other side's factors
+    val: Any  # [B, L] f32 — ratings (0 where masked)
+    mask: Any  # [B, L] f32 — 1 for real entries
+
+
+class BucketedRatings(NamedTuple):
+    """One side of the ratings matrix in solver layout: a handful of
+    fixed-width padded buckets (static shapes for XLA)."""
+
+    buckets: tuple  # tuple[_Bucket, ...]
+    num_rows: int
+    num_cols: int
+
+
+def build_buckets(
+    rows: np.ndarray,
+    cols: np.ndarray,
+    vals: np.ndarray,
+    num_rows: int,
+    num_cols: int,
+    widths: Sequence[int] = _DEFAULT_BUCKET_WIDTHS,
+    row_multiple: int = 8,
+) -> BucketedRatings:
+    """Host-side: COO ratings -> per-row padded buckets.
+
+    Rows are grouped by rating count into the smallest width that fits;
+    each bucket's row count is padded to ``row_multiple`` (keep it a
+    multiple of the mesh's data-axis size so shards divide evenly).
+    Rows with zero ratings are omitted — their factors stay zero.
+    """
+    rows = np.asarray(rows, dtype=np.int64)
+    cols = np.asarray(cols, dtype=np.int64)
+    vals = np.asarray(vals, dtype=np.float32)
+    if not (rows.shape == cols.shape == vals.shape):
+        raise ValueError("rows/cols/vals must be 1-D arrays of equal length")
+    if rows.size and (rows.min() < 0 or rows.max() >= num_rows):
+        raise ValueError("row index out of range")
+    if cols.size and (cols.min() < 0 or cols.max() >= num_cols):
+        raise ValueError("column index out of range")
+
+    order = np.argsort(rows, kind="stable")
+    rows_s, cols_s, vals_s = rows[order], cols[order], vals[order]
+    uniq, starts, counts = np.unique(rows_s, return_index=True, return_counts=True)
+
+    max_count = int(counts.max()) if counts.size else 0
+    usable = [w for w in sorted(widths) if w >= 1]
+    if not usable or max_count > usable[-1]:
+        usable.append(max(max_count, 1))
+
+    # assign each unique row to the smallest width that fits
+    width_of = np.empty(len(uniq), dtype=np.int64)
+    for w in sorted(usable, reverse=True):
+        width_of[counts <= w] = w
+
+    buckets = []
+    for w in sorted(set(usable)):
+        sel = np.nonzero(width_of == w)[0]
+        if sel.size == 0:
+            continue
+        n = int(sel.size)
+        n_pad = -(-n // row_multiple) * row_multiple
+        row_id = np.full(n_pad, num_rows, dtype=np.int32)
+        idx = np.zeros((n_pad, w), dtype=np.int32)
+        val = np.zeros((n_pad, w), dtype=np.float32)
+        mask = np.zeros((n_pad, w), dtype=np.float32)
+        for out_i, u_i in enumerate(sel):
+            c = int(counts[u_i])
+            s = int(starts[u_i])
+            row_id[out_i] = uniq[u_i]
+            idx[out_i, :c] = cols_s[s : s + c]
+            val[out_i, :c] = vals_s[s : s + c]
+            mask[out_i, :c] = 1.0
+        buckets.append(_Bucket(row_id, idx, val, mask))
+    return BucketedRatings(tuple(buckets), num_rows, num_cols)
+
+
+# ---------------------------------------------------------------------------
+# Solver kernels (pure, jit-compiled)
+# ---------------------------------------------------------------------------
+
+
+def _solve_bucket(
+    other_factors: jax.Array,  # [num_cols+1, K] — includes zero sentinel row
+    bucket: _Bucket,
+    reg: float,
+    implicit: bool,
+    alpha: float,
+    yty: jax.Array | None,  # [K, K], implicit only
+    mesh: Mesh | None,
+    data_axis: str | None,  # mesh axis bucket rows are sharded over
+) -> jax.Array:
+    """New factors for one bucket's rows: batched normal equations.
+
+    All heavy ops are [B,L,K]-shaped einsums -> MXU; solve is batched
+    Cholesky on [B,K,K].
+    """
+    K = other_factors.shape[-1]
+    if mesh is not None:
+        # replicated table, row-sharded indices -> row-sharded gather; the
+        # out_sharding makes the GSPMD decision explicit (each device
+        # gathers only its rows' factors — the ALX sharded-gather step).
+        gathered = other_factors.at[bucket.idx].get(
+            out_sharding=NamedSharding(mesh, PartitionSpec(data_axis, None, None))
+        )
+    else:
+        gathered = other_factors[bucket.idx]
+    Q = gathered * bucket.mask[..., None]  # [B, L, K]
+    eye = jnp.eye(K, dtype=other_factors.dtype)
+    # Normal equations are solve-accuracy-sensitive: force full-f32 MXU
+    # passes rather than the TPU's default bf16 matmul precision.
+    hi = jax.lax.Precision.HIGHEST
+    if implicit:
+        conf_minus_1 = alpha * jnp.abs(bucket.val) * bucket.mask  # c - 1
+        pref = (bucket.val > 0).astype(Q.dtype) * bucket.mask
+        A = (
+            yty
+            + jnp.einsum("blk,bl,blj->bkj", Q, conf_minus_1, Q, precision=hi)
+            + reg * eye
+        )
+        b = jnp.einsum("blk,bl->bk", Q, (1.0 + conf_minus_1) * pref, precision=hi)
+    else:
+        n_ratings = bucket.mask.sum(axis=-1)  # [B]
+        A = jnp.einsum("blk,blj->bkj", Q, Q, precision=hi) + (
+            reg * jnp.maximum(n_ratings, 1.0)[:, None, None] * eye
+        )
+        b = jnp.einsum("blk,bl->bk", Q, bucket.val * bucket.mask, precision=hi)
+    # SPD by construction -> Cholesky
+    L = jax.lax.linalg.cholesky(A)
+    x = jax.lax.linalg.triangular_solve(
+        L, b[..., None], left_side=True, lower=True
+    )
+    x = jax.lax.linalg.triangular_solve(
+        L, x, left_side=True, lower=True, transpose_a=True
+    )
+    return x[..., 0]  # [B, K]
+
+
+def _half_sweep(
+    factors: jax.Array,  # [num_rows+1, K] — side being updated
+    other_factors: jax.Array,  # [num_cols+1, K]
+    buckets: tuple,
+    reg: float,
+    implicit: bool,
+    alpha: float,
+    mesh: Mesh | None,
+    data_axis: str | None,
+) -> jax.Array:
+    yty = None
+    if implicit:
+        # Gramian over the *other* side; sentinel row is zero so it is a
+        # no-op term. On a mesh this is a sharded matmul + psum over ICI.
+        yty = jnp.matmul(
+            other_factors.T, other_factors, precision=jax.lax.Precision.HIGHEST
+        )
+    for bucket in buckets:
+        new_rows = _solve_bucket(
+            other_factors, bucket, reg, implicit, alpha, yty, mesh, data_axis
+        )
+        if mesh is not None:
+            # scatter sharded rows into the replicated factor table — GSPMD
+            # lowers this to the per-shard update + all-gather over ICI
+            # that replaces MLlib's factor-block shuffle.
+            factors = factors.at[bucket.row_id].set(
+                new_rows, out_sharding=NamedSharding(mesh, PartitionSpec(None, None))
+            )
+        else:
+            factors = factors.at[bucket.row_id].set(new_rows)
+    # padding rows scattered into the sentinel; re-zero it
+    return factors.at[factors.shape[0] - 1].set(0.0)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("reg", "implicit", "alpha", "mesh", "data_axis"),
+    donate_argnums=(0, 1),
+)
+def als_sweep(
+    user_factors: jax.Array,
+    item_factors: jax.Array,
+    user_buckets: tuple,
+    item_buckets: tuple,
+    reg: float,
+    implicit: bool,
+    alpha: float,
+    mesh: Mesh | None = None,
+    data_axis: str | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """One full ALS iteration: solve users given items, then items given
+    users. Compiled once; buffers donated so factors update in place."""
+    user_factors = _half_sweep(
+        user_factors, item_factors, user_buckets, reg, implicit, alpha, mesh, data_axis
+    )
+    item_factors = _half_sweep(
+        item_factors, user_factors, item_buckets, reg, implicit, alpha, mesh, data_axis
+    )
+    return user_factors, item_factors
+
+
+def _device_buckets(b: BucketedRatings, mesh: Mesh | None, data_axis: str) -> tuple:
+    """Place bucket arrays on device — rows sharded over the mesh's data
+    axis when a mesh is given (replaces Spark's RDD partitioning)."""
+    out = []
+    for bucket in b.buckets:
+        if mesh is not None:
+            row_sharded_1d = NamedSharding(mesh, PartitionSpec(data_axis))
+            row_sharded_2d = NamedSharding(mesh, PartitionSpec(data_axis, None))
+            out.append(
+                _Bucket(
+                    jax.device_put(bucket.row_id, row_sharded_1d),
+                    jax.device_put(bucket.idx, row_sharded_2d),
+                    jax.device_put(bucket.val, row_sharded_2d),
+                    jax.device_put(bucket.mask, row_sharded_2d),
+                )
+            )
+        else:
+            out.append(
+                _Bucket(
+                    jnp.asarray(bucket.row_id),
+                    jnp.asarray(bucket.idx),
+                    jnp.asarray(bucket.val),
+                    jnp.asarray(bucket.mask),
+                )
+            )
+    return tuple(out)
+
+
+def train_als(
+    rows: np.ndarray,
+    cols: np.ndarray,
+    vals: np.ndarray,
+    num_users: int,
+    num_items: int,
+    config: ALSConfig = ALSConfig(),
+    mesh: Mesh | None = None,
+    data_axis: str = "data",
+) -> ALSFactors:
+    """Train factor matrices from COO ratings.
+
+    Returns host-strippable ``ALSFactors`` with the sentinel rows removed:
+    ``user [num_users, K]``, ``item [num_items, K]``.
+    """
+    rank = config.rank
+    if config.rank_pad_multiple:
+        rank = -(-rank // config.rank_pad_multiple) * config.rank_pad_multiple
+
+    row_multiple = 8
+    if mesh is not None:
+        row_multiple = max(8, mesh.shape.get(data_axis, 1))
+    user_b = build_buckets(rows, cols, vals, num_users, num_items, row_multiple=row_multiple)
+    item_b = build_buckets(cols, rows, vals, num_items, num_users, row_multiple=row_multiple)
+
+    key_u, key_i = jax.random.split(jax.random.PRNGKey(config.seed))
+    scale = 1.0 / np.sqrt(rank)
+    # MLlib seeds factors with abs(normal)/sqrt(rank) — keeps implicit ALS
+    # preferences non-negative at iteration 0.
+    uf = jnp.abs(jax.random.normal(key_u, (num_users + 1, rank), jnp.float32)) * scale
+    vf = jnp.abs(jax.random.normal(key_i, (num_items + 1, rank), jnp.float32)) * scale
+    uf = uf.at[num_users].set(0.0)
+    vf = vf.at[num_items].set(0.0)
+    if mesh is not None:
+        replicated = NamedSharding(mesh, PartitionSpec())
+        uf = jax.device_put(uf, replicated)
+        vf = jax.device_put(vf, replicated)
+
+    user_buckets = _device_buckets(user_b, mesh, data_axis)
+    item_buckets = _device_buckets(item_b, mesh, data_axis)
+
+    for _ in range(config.iterations):
+        uf, vf = als_sweep(
+            uf, vf, user_buckets, item_buckets,
+            reg=config.reg, implicit=config.implicit, alpha=config.alpha,
+            mesh=mesh, data_axis=data_axis if mesh is not None else None,
+        )
+    return ALSFactors(user=uf[:num_users], item=vf[:num_items])
+
+
+# ---------------------------------------------------------------------------
+# Inference kernels
+# ---------------------------------------------------------------------------
+
+
+@jax.jit
+def predict_scores(user_vec: jax.Array, item_factors: jax.Array) -> jax.Array:
+    """Scores of one user against all items: ``item_factors @ user_vec``."""
+    return item_factors @ user_vec
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def top_k_items(
+    user_vec: jax.Array,
+    item_factors: jax.Array,
+    k: int,
+    exclude_mask: jax.Array | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """Top-k item ids + scores for one user. ``exclude_mask`` (bool [I])
+    drops items (e.g. already-rated) by sending them to -inf — the
+    serving-time filter of the reference's recommendation templates."""
+    scores = item_factors @ user_vec
+    if exclude_mask is not None:
+        scores = jnp.where(exclude_mask, -jnp.inf, scores)
+    values, indices = jax.lax.top_k(scores, k)
+    return indices, values

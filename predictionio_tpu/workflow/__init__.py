@@ -1,0 +1,26 @@
+"""Workflow runtime — train/eval/deploy drivers.
+
+Parity: ``core/src/main/scala/org/apache/predictionio/workflow/``
+(SURVEY.md section 3.3): ``CreateWorkflow`` (train entry), ``CoreWorkflow``
+(train orchestration + EngineInstance lineage), ``EvaluationWorkflow``,
+``CreateServer`` (query server, in ``predictionio_tpu.workflow.serving``).
+
+The key architectural change from the reference: there is no spark-submit
+process boundary. ``pio train`` runs the workflow **in-process** on the TPU
+host; multi-host jobs use ``jax.distributed`` (SURVEY.md section 8.1).
+"""
+
+from predictionio_tpu.workflow.core import (
+    WorkflowParams,
+    run_evaluation,
+    run_train,
+)
+from predictionio_tpu.workflow.engine_json import EngineVariant, load_engine_variant
+
+__all__ = [
+    "EngineVariant",
+    "WorkflowParams",
+    "load_engine_variant",
+    "run_evaluation",
+    "run_train",
+]

@@ -1,0 +1,168 @@
+"""Core workflow: train and evaluation runners with lineage records.
+
+Parity: ``core/workflow/CoreWorkflow.scala`` (``runTrain`` — train, persist
+models, insert COMPLETED ``EngineInstance`` with timings; ``runEvaluation``)
+and the argument surface of ``core/workflow/WorkflowParams.scala``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime as _dt
+import json
+import logging
+import uuid
+
+from predictionio_tpu.controller.context import WorkflowContext
+from predictionio_tpu.controller.engine import EngineParams
+from predictionio_tpu.controller.evaluation import (
+    EngineParamsGenerator,
+    Evaluation,
+    MetricEvaluator,
+    MetricEvaluatorResult,
+)
+from predictionio_tpu.controller.params import params_to_json
+from predictionio_tpu.data.storage import Storage
+from predictionio_tpu.data.storage.base import EngineInstance, EvaluationInstance, Model
+from predictionio_tpu.workflow.engine_json import EngineVariant
+
+__all__ = ["WorkflowParams", "run_train", "run_evaluation"]
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class WorkflowParams:
+    """Invocation flags (parity: ``WorkflowParams.scala``)."""
+
+    batch: str = ""
+    verbose: int = 0
+    save_model: bool = True
+    skip_sanity_check: bool = False
+    stop_after_read: bool = False
+    stop_after_prepare: bool = False
+
+
+def _now() -> _dt.datetime:
+    return _dt.datetime.now(_dt.timezone.utc)
+
+
+def _params_json(ep: EngineParams) -> dict[str, str]:
+    return {
+        "datasource_params": json.dumps(params_to_json(ep.datasource)),
+        "preparator_params": json.dumps(params_to_json(ep.preparator)),
+        "algorithms_params": json.dumps(
+            [{"name": n, "params": params_to_json(p)} for n, p in ep.algorithms]
+        ),
+        "serving_params": json.dumps(params_to_json(ep.serving)),
+    }
+
+
+def run_train(
+    variant: EngineVariant,
+    ctx: WorkflowContext,
+    workflow_params: WorkflowParams = WorkflowParams(),
+    engine_id: str | None = None,
+    engine_version: str = "",
+) -> EngineInstance:
+    """Train an engine variant end-to-end and record its lineage.
+
+    Flow (parity: ``CoreWorkflow.runTrain``): insert a TRAINING
+    ``EngineInstance`` -> ``Engine.train`` -> persist model blob into the
+    ``Models`` repo -> update the instance to COMPLETED with timings and
+    the resolved component params. On error the instance is marked FAILED
+    and the exception re-raised.
+    """
+    engine = variant.build_engine()
+    engine_params = variant.engine_params(engine)
+    instances = Storage.get_meta_data_engine_instances()
+
+    instance = EngineInstance(
+        id=uuid.uuid4().hex,
+        status="TRAINING",
+        start_time=_now(),
+        end_time=_now(),
+        engine_id=engine_id or variant.id,
+        engine_version=engine_version or variant.version,
+        engine_variant=variant.id,
+        engine_factory=variant.engine_factory,
+        batch=workflow_params.batch,
+        mesh_conf=(
+            {"devices": str(ctx.num_devices), "axes": str(dict(ctx.mesh.shape))}
+            if ctx.has_mesh
+            else {}
+        ),
+        **_params_json(engine_params),
+    )
+    instances.insert(instance)
+    try:
+        models = engine.train(
+            ctx,
+            engine_params,
+            sanity_check=not workflow_params.skip_sanity_check,
+            stop_after_read=workflow_params.stop_after_read,
+            stop_after_prepare=workflow_params.stop_after_prepare,
+        )
+        if workflow_params.stop_after_read or workflow_params.stop_after_prepare:
+            # debugging run — nothing to persist (parity: reference aborts
+            # after printing the data); record it as not-completed.
+            instance = instance.with_status("STOPPED", end_time=_now())
+            instances.update(instance)
+            return instance
+        if workflow_params.save_model:
+            blob = engine.models_to_bytes(instance.id, engine_params, models)
+            Storage.get_model_data_models().insert(Model(id=instance.id, models=blob))
+            logger.info("Saved model blob for instance %s (%d bytes)", instance.id, len(blob))
+        instance = instance.with_status("COMPLETED", end_time=_now())
+        instances.update(instance)
+        logger.info(
+            "Training completed: instance %s in %.1fs",
+            instance.id,
+            (instance.end_time - instance.start_time).total_seconds(),
+        )
+        return instance
+    except Exception:
+        instances.update(instance.with_status("FAILED", end_time=_now()))
+        raise
+
+
+def run_evaluation(
+    evaluation: Evaluation,
+    generator: EngineParamsGenerator,
+    ctx: WorkflowContext,
+    workflow_params: WorkflowParams = WorkflowParams(),
+    evaluation_class: str = "",
+    generator_class: str = "",
+) -> tuple[EvaluationInstance, MetricEvaluatorResult]:
+    """Run a parameter sweep and record an ``EvaluationInstance``
+    (parity: ``CoreWorkflow.runEvaluation`` + ``EvaluationWorkflow``)."""
+    instances = Storage.get_meta_data_evaluation_instances()
+    instance = EvaluationInstance(
+        id=uuid.uuid4().hex,
+        status="EVALUATING",
+        start_time=_now(),
+        end_time=_now(),
+        evaluation_class=evaluation_class or type(evaluation).__name__,
+        engine_params_generator_class=generator_class or type(generator).__name__,
+        batch=workflow_params.batch,
+    )
+    instances.insert(instance)
+    try:
+        evaluator = MetricEvaluator(
+            metric=evaluation.metric, other_metrics=tuple(evaluation.other_metrics)
+        )
+        result = evaluator.evaluate_base(
+            ctx, evaluation.engine, list(generator.engine_params_list)
+        )
+        instance = dataclasses.replace(
+            instance,
+            status="EVALCOMPLETED",
+            end_time=_now(),
+            evaluator_results=result.leaderboard(),
+            evaluator_results_json=json.dumps(result.to_json(), default=str),
+        )
+        instances.update(instance)
+        return instance, result
+    except Exception:
+        instances.update(dataclasses.replace(instance, status="FAILED", end_time=_now()))
+        raise

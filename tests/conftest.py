@@ -8,12 +8,21 @@ test fixture (SURVEY.md section 5.1).
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force the virtual 8-device CPU platform. The sandbox's sitecustomize
+# imports jax at interpreter start with JAX_PLATFORMS pointing at the real
+# TPU tunnel, so env vars alone are too late — update the jax config before
+# any backend is initialized (backends are created lazily at first
+# jax.devices()/dispatch).
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

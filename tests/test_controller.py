@@ -230,6 +230,19 @@ class TestEngineJsonParams:
         with pytest.raises(ValueError, match="unknown algorithm"):
             engine0().params_from_json({"algorithms": [{"name": "zzz"}]})
 
+    def test_missing_params_wrapper_raises(self):
+        # params written at the component level instead of under "params"
+        with pytest.raises(ValueError, match="unexpected key"):
+            engine0().params_from_json({"datasource": {"base": 20}})
+        with pytest.raises(ValueError, match="unexpected key"):
+            engine0().params_from_json({"algorithms": [{"name": "a0", "mult": 7}]})
+
+    def test_models_to_bytes_length_mismatch(self):
+        eng = engine0()
+        ep = simple_params()
+        with pytest.raises(ValueError, match="align 1:1"):
+            eng.models_to_bytes("i", ep, [1])  # 1 model, 2 algorithms
+
 
 def test_resolve_engine_factory():
     factory = resolve_engine_factory("fake_dase:engine0")
