@@ -1,0 +1,60 @@
+"""Event-server live statistics.
+
+Parity: ``data/api/Stats.scala`` + ``StatsActor`` — counts events by
+(appId, status-code, event-name, entity-type) over start-of-minute time
+buckets, served at ``/stats.json`` when the server runs with ``--stats``.
+Single-writer here (the service locks), no actor needed.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import threading
+from collections import Counter
+
+__all__ = ["Stats"]
+
+
+def _bucket(dt: _dt.datetime) -> _dt.datetime:
+    return dt.replace(second=0, microsecond=0)
+
+
+class Stats:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.start_time = _dt.datetime.now(_dt.timezone.utc)
+        # (appId, bucket) -> Counter keyed by ("status", code) /
+        # ("event", name) / ("etype", entityType)
+        self._counts: dict[tuple[int, _dt.datetime], Counter] = {}
+
+    def update(
+        self,
+        app_id: int,
+        status_code: int,
+        event_name: str | None = None,
+        entity_type: str | None = None,
+        when: _dt.datetime | None = None,
+    ) -> None:
+        when = _bucket(when or _dt.datetime.now(_dt.timezone.utc))
+        with self._lock:
+            c = self._counts.setdefault((app_id, when), Counter())
+            c[("status", str(status_code))] += 1
+            if event_name:
+                c[("event", event_name)] += 1
+            if entity_type:
+                c[("etype", entity_type)] += 1
+
+    def to_json(self) -> dict:
+        with self._lock:
+            out = []
+            for (app_id, bucket), c in sorted(self._counts.items(), key=lambda kv: (kv[0][1], kv[0][0])):
+                out.append(
+                    {
+                        "appId": app_id,
+                        "bucket": bucket.isoformat(),
+                        "status": {k: v for (kind, k), v in c.items() if kind == "status"},
+                        "event": {k: v for (kind, k), v in c.items() if kind == "event"},
+                        "entityType": {k: v for (kind, k), v in c.items() if kind == "etype"},
+                    }
+                )
+            return {"startTime": self.start_time.isoformat(), "statsByMinute": out}

@@ -114,7 +114,12 @@ class Engine:
         algo_entries = obj.get("algorithms") or []
         algorithms = []
         for entry in algo_entries:
-            name = entry.get("name") if isinstance(entry, Mapping) else None
+            if not isinstance(entry, Mapping):
+                raise ValueError(
+                    f"engine.json algorithms entries must be objects like "
+                    f'{{"name": ..., "params": {{...}}}}; got {entry!r}'
+                )
+            name = entry.get("name")
             if name not in self.algorithms_class_map:
                 raise ValueError(
                     f"engine.json names unknown algorithm '{name}'; "

@@ -10,6 +10,11 @@ A ``Params`` subclass is normally a ``@dataclass``; any object with an
 extractor is deliberately strict: unknown JSON keys raise, so a typo'd
 ``engine.json`` fails at load time, not mid-train (the reference gets this
 from case-class field matching).
+
+For byte-compatibility with reference engine.json files (camelCase keys,
+and keys like ``lambda`` that are Python keywords), a Params class may
+declare ``json_aliases = {"numIterations": "num_iterations", ...}`` —
+JSON key -> field name. Aliases apply in both directions.
 """
 
 from __future__ import annotations
@@ -59,10 +64,25 @@ def params_to_json(params: Any) -> dict[str, Any]:
     if params is None or isinstance(params, EmptyParams):
         return {}
     if dataclasses.is_dataclass(params) and not isinstance(params, type):
-        return dataclasses.asdict(params)
-    if hasattr(params, "__dict__"):
-        return {k: v for k, v in vars(params).items() if not k.startswith("_")}
-    raise ParamsError(f"Cannot serialize params of type {type(params).__name__}")
+        out = dataclasses.asdict(params)
+    elif hasattr(params, "__dict__"):
+        out = {k: v for k, v in vars(params).items() if not k.startswith("_")}
+    else:
+        raise ParamsError(f"Cannot serialize params of type {type(params).__name__}")
+    aliases = getattr(type(params), "json_aliases", None)
+    if aliases:
+        reverse = {field: json_key for json_key, field in aliases.items()}
+        renamed: dict[str, Any] = {}
+        for k, v in out.items():
+            target = reverse.get(k, k)
+            if target in renamed:
+                raise ParamsError(
+                    f"json_aliases of {type(params).__name__} map two fields "
+                    f"to the same JSON key '{target}'"
+                )
+            renamed[target] = v
+        out = renamed
+    return out
 
 
 def params_from_json(cls: Type[P], obj: Mapping[str, Any] | None) -> P:
@@ -73,6 +93,18 @@ def params_from_json(cls: Type[P], obj: Mapping[str, Any] | None) -> P:
     * unknown keys raise :class:`ParamsError`.
     """
     obj = dict(obj or {})
+    aliases = getattr(cls, "json_aliases", None)
+    if aliases:
+        remapped: dict[str, Any] = {}
+        for k, v in obj.items():
+            target = aliases.get(k, k)
+            if target in remapped:
+                raise ParamsError(
+                    f"Conflicting keys for {cls.__name__}.{target}: JSON "
+                    f"supplies both an alias and the field name"
+                )
+            remapped[target] = v
+        obj = remapped
     if cls is EmptyParams or cls is Params:
         if obj:
             raise ParamsError(f"{cls.__name__} accepts no parameters, got {sorted(obj)}")

@@ -144,13 +144,20 @@ def build_buckets(
         idx = np.zeros((n_pad, w), dtype=np.int32)
         val = np.zeros((n_pad, w), dtype=np.float32)
         mask = np.zeros((n_pad, w), dtype=np.float32)
-        for out_i, u_i in enumerate(sel):
-            c = int(counts[u_i])
-            s = int(starts[u_i])
-            row_id[out_i] = uniq[u_i]
-            idx[out_i, :c] = cols_s[s : s + c]
-            val[out_i, :c] = vals_s[s : s + c]
-            mask[out_i, :c] = 1.0
+        row_id[:n] = uniq[sel]
+        # vectorized ragged fill: flat destination (row, lane) pairs for
+        # every rating of the bucket's rows — no per-row Python loop
+        # (this runs at full-catalog scale before the first TPU step)
+        c_sel = counts[sel]
+        dst_row = np.repeat(np.arange(n), c_sel)
+        lane_end = np.cumsum(c_sel)
+        dst_lane = np.arange(int(lane_end[-1]) if n else 0) - np.repeat(
+            lane_end - c_sel, c_sel
+        )
+        src = np.repeat(starts[sel], c_sel) + dst_lane
+        idx[dst_row, dst_lane] = cols_s[src]
+        val[dst_row, dst_lane] = vals_s[src]
+        mask[dst_row, dst_lane] = 1.0
         buckets.append(_Bucket(row_id, idx, val, mask))
     return BucketedRatings(tuple(buckets), num_rows, num_cols)
 
@@ -326,7 +333,8 @@ def train_als(
 
     row_multiple = 8
     if mesh is not None:
-        row_multiple = max(8, mesh.shape.get(data_axis, 1))
+        # must be a multiple of the data-axis size so shards divide evenly
+        row_multiple = int(np.lcm(8, mesh.shape.get(data_axis, 1)))
     user_b = build_buckets(rows, cols, vals, num_users, num_items, row_multiple=row_multiple)
     item_b = build_buckets(cols, rows, vals, num_items, num_users, row_multiple=row_multiple)
 

@@ -1,0 +1,9 @@
+"""Engine templates — runnable engines shipped with the framework.
+
+Parity: the reference's engine-template family (Recommendation,
+Classification, Similar-Product, E-Commerce, Text-Classification), which
+live in separate repos upstream but ship as ``examples/`` copies
+(SURVEY.md section 3.7). Here they are first-class packages so
+``engine.json`` files can name them directly, e.g.
+``"engineFactory": "predictionio_tpu.templates.recommendation:engine_factory"``.
+"""

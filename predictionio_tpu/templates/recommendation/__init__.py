@@ -1,0 +1,32 @@
+"""Recommendation engine template (TPU ALS).
+
+Capability parity with the reference's scala-parallel-recommendation
+template (``examples/scala-parallel-recommendation/``; MLlib explicit-ALS
+based): read ``rate``/``buy`` events, train matrix factors, answer
+``{"user": "1", "num": 4}`` queries with
+``{"itemScores": [{"item": "...", "score": ...}, ...]}``.
+"""
+
+from predictionio_tpu.templates.recommendation.engine import (
+    ALSAlgorithm,
+    ALSAlgorithmParams,
+    ALSModel,
+    DataSourceParams,
+    PredictedResult,
+    Query,
+    RecommendationDataSource,
+    TrainingData,
+    engine_factory,
+)
+
+__all__ = [
+    "ALSAlgorithm",
+    "ALSAlgorithmParams",
+    "ALSModel",
+    "DataSourceParams",
+    "PredictedResult",
+    "Query",
+    "RecommendationDataSource",
+    "TrainingData",
+    "engine_factory",
+]

@@ -68,6 +68,14 @@ class TestBuildBuckets:
         with pytest.raises(ValueError, match="out of range"):
             build_buckets(np.array([5]), np.array([0]), np.array([1.0]), 4, 2)
 
+    def test_row_multiple_lcm_with_odd_axis_sizes(self):
+        # regression: a 6-device data axis needs lcm(8,6)=24, not max(8,6)=8
+        rows, cols, vals, _ = synthetic_ratings()
+        for mult in (24, 40):  # lcm(8,6), lcm(8,5)
+            b = build_buckets(rows, cols, vals, 60, 40, row_multiple=mult)
+            for bucket in b.buckets:
+                assert bucket.row_id.shape[0] % mult == 0
+
 
 class TestExplicitSolveVsNumpy:
     def test_half_sweep_matches_direct_solve(self):

@@ -54,6 +54,17 @@ class TestParams:
         with pytest.raises(ParamsError, match="Unknown parameter"):
             params_from_json(MyParams, {"rnk": 16})
 
+    def test_alias_collision_raises(self):
+        @dataclasses.dataclass(frozen=True)
+        class Aliased(Params):
+            num_iterations: int = 5
+            json_aliases = {"numIterations": "num_iterations"}
+
+        assert params_from_json(Aliased, {"numIterations": 9}).num_iterations == 9
+        assert Aliased(7).to_json() == {"numIterations": 7}
+        with pytest.raises(ParamsError, match="Conflicting keys"):
+            params_from_json(Aliased, {"numIterations": 5, "num_iterations": 20})
+
     def test_empty_params(self):
         assert isinstance(params_from_json(EmptyParams, {}), EmptyParams)
         with pytest.raises(ParamsError):

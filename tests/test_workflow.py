@@ -1,0 +1,140 @@
+"""Workflow runtime tests: engine.json loading, run_train lineage +
+model persistence, run_evaluation records. Uses the fake-DASE engine and
+the in-memory storage fixture."""
+
+import json
+
+import pytest
+
+from predictionio_tpu.controller import (
+    EngineParams,
+    EngineParamsGenerator,
+    Evaluation,
+    AverageMetric,
+    FirstServing,
+    local_context,
+)
+from predictionio_tpu.workflow import (
+    WorkflowParams,
+    load_engine_variant,
+    run_evaluation,
+    run_train,
+)
+
+from fake_dase import AlgoParams, DSParams, engine0, simple_params
+
+VARIANT = {
+    "id": "fake-engine",
+    "version": "0.1",
+    "description": "fake DASE engine",
+    "engineFactory": "fake_dase:engine0",
+    "datasource": {"params": {"base": 10}},
+    "algorithms": [
+        {"name": "a0", "params": {"mult": 2}},
+        {"name": "a1", "params": {"mult": 3}},
+    ],
+}
+
+
+class TestEngineVariant:
+    def test_load_from_obj(self):
+        v = load_engine_variant(VARIANT)
+        assert v.id == "fake-engine"
+        eng = v.build_engine()
+        ep = v.engine_params(eng)
+        assert ep.datasource == DSParams(base=10)
+        assert ep.algorithms == (("a0", AlgoParams(2)), ("a1", AlgoParams(3)))
+
+    def test_load_from_file(self, tmp_path):
+        p = tmp_path / "engine.json"
+        p.write_text(json.dumps(VARIANT))
+        v = load_engine_variant(str(p))
+        assert v.engine_factory == "fake_dase:engine0"
+
+    def test_missing_factory_raises(self):
+        with pytest.raises(ValueError, match="engineFactory"):
+            load_engine_variant({"id": "x"})
+
+    def test_missing_file_raises(self):
+        with pytest.raises(FileNotFoundError):
+            load_engine_variant("/nonexistent/engine.json")
+
+
+class TestRunTrain:
+    def test_completed_instance_and_model_blob(self, memory_storage_env):
+        Storage = memory_storage_env
+        variant = load_engine_variant(VARIANT)
+        instance = run_train(variant, local_context(), WorkflowParams(batch="b1"))
+        assert instance.status == "COMPLETED"
+        assert instance.batch == "b1"
+        assert instance.engine_factory == "fake_dase:engine0"
+        # params recorded for reproducibility
+        assert json.loads(instance.algorithms_params)[0] == {
+            "name": "a0", "params": {"mult": 2}
+        }
+        # model blob persisted under the instance id
+        blob = Storage.get_model_data_models().get(instance.id)
+        assert blob is not None and len(blob.models) > 0
+        # metadata repo agrees
+        got = Storage.get_meta_data_engine_instances().get_latest_completed(
+            "fake-engine", "0.1", "fake-engine"
+        )
+        assert got is not None and got.id == instance.id
+
+    def test_failed_instance_on_error(self, memory_storage_env, monkeypatch):
+        Storage = memory_storage_env
+
+        class Boom(Exception):
+            pass
+
+        import fake_dase
+
+        class BoomAlgo(fake_dase.Algo0):
+            def train(self, ctx, pd):
+                raise Boom("train exploded")
+
+        def boom_engine():
+            eng = engine0()
+            eng.algorithms_class_map = {"a0": BoomAlgo, "a1": BoomAlgo}
+            return eng
+
+        monkeypatch.setattr(fake_dase, "engine0", boom_engine)
+        with pytest.raises(Boom):
+            run_train(load_engine_variant(VARIANT), local_context())
+        all_instances = Storage.get_meta_data_engine_instances().get_all()
+        assert any(i.status == "FAILED" for i in all_instances)
+
+    def test_stop_after_read(self, memory_storage_env):
+        Storage = memory_storage_env
+        instance = run_train(
+            load_engine_variant(VARIANT), local_context(),
+            WorkflowParams(stop_after_read=True),
+        )
+        assert instance.status == "STOPPED"
+        assert Storage.get_model_data_models().get(instance.id) is None
+
+
+class MAE(AverageMetric):
+    def calculate_unit(self, q, p, a):
+        return -abs(p - a)
+
+
+class TestRunEvaluation:
+    def test_records_evaluation_instance(self, memory_storage_env):
+        Storage = memory_storage_env
+        eng = engine0()
+        eng.serving_class = FirstServing
+        candidates = [
+            EngineParams(datasource=DSParams(), algorithms=(("a0", AlgoParams(mult=5)),)),
+            EngineParams(datasource=DSParams(), algorithms=(("a0", AlgoParams(mult=1)),)),
+        ]
+        evaluation = Evaluation(engine=eng, metric=MAE())
+        generator = EngineParamsGenerator(candidates)
+        instance, result = run_evaluation(evaluation, generator, local_context())
+        assert instance.status == "EVALCOMPLETED"
+        assert result.best_index == 1
+        stored = Storage.get_meta_data_evaluation_instances().get(instance.id)
+        assert stored.status == "EVALCOMPLETED"
+        parsed = json.loads(stored.evaluator_results_json)
+        assert parsed["bestIdx"] == 1
+        assert "BEST" in stored.evaluator_results
