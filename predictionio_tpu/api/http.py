@@ -1,0 +1,102 @@
+"""Stdlib HTTP wrapper around the handler cores.
+
+Parity: the spray-can ``Http.Bind`` layer of ``data/api/EventServer.scala``
+and ``core/workflow/CreateServer.scala``. A small threading HTTP server is
+all the transport the framework needs — handler logic lives in the
+transport-agnostic service objects, matching the reference's actor/route
+split and keeping tests in-process.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Mapping
+
+__all__ = ["serve", "start_background"]
+
+logger = logging.getLogger(__name__)
+
+#: signature shared with EventService.dispatch / QueryService.dispatch
+Dispatcher = Callable[..., "object"]
+
+
+def _make_handler(dispatch: Dispatcher):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):  # route through logging, not stderr
+            logger.debug("%s - %s", self.address_string(), fmt % args)
+
+        def _respond(self):
+            parsed = urllib.parse.urlparse(self.path)
+            params = {
+                k: v[0] for k, v in urllib.parse.parse_qs(parsed.query).items()
+            }
+            body = None
+            form: Mapping[str, str] | None = None
+            length = int(self.headers.get("Content-Length") or 0)
+            raw = self.rfile.read(length) if length else b""
+            ctype = (self.headers.get("Content-Type") or "").split(";")[0].strip()
+            if raw:
+                if ctype == "application/x-www-form-urlencoded":
+                    form = {
+                        k: v[0]
+                        for k, v in urllib.parse.parse_qs(raw.decode()).items()
+                    }
+                else:
+                    try:
+                        body = json.loads(raw)
+                    except json.JSONDecodeError:
+                        self._send(400, b'{"message": "Malformed JSON."}')
+                        return
+            try:
+                resp = dispatch(
+                    method=self.command,
+                    path=parsed.path,
+                    params=params,
+                    body=body,
+                    headers=dict(self.headers),
+                    form=form,
+                )
+            except Exception:
+                logger.exception("Unhandled error for %s %s", self.command, parsed.path)
+                self._send(500, b'{"message": "Internal Server Error"}')
+                return
+            self._send(resp.status, resp.json_bytes())
+
+        def _send(self, status: int, payload: bytes):
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json; charset=UTF-8")
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+
+        do_GET = do_POST = do_DELETE = do_PUT = _respond
+
+    return Handler
+
+
+def serve(dispatch: Dispatcher, host: str = "0.0.0.0", port: int = 7070) -> None:
+    """Blocking serve-forever (used by ``pio eventserver`` / ``pio deploy``)."""
+    server = ThreadingHTTPServer((host, port), _make_handler(dispatch))
+    logger.info("Listening on %s:%d", host, port)
+    try:
+        server.serve_forever()
+    finally:
+        server.server_close()
+
+
+def start_background(
+    dispatch: Dispatcher, host: str = "127.0.0.1", port: int = 0
+) -> tuple[ThreadingHTTPServer, threading.Thread]:
+    """Start on a daemon thread; returns (server, thread). ``port=0`` picks
+    a free port (``server.server_address[1]``). Used by tests and the
+    feedback loop."""
+    server = ThreadingHTTPServer((host, port), _make_handler(dispatch))
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    return server, thread

@@ -1,0 +1,300 @@
+"""Event-server handler core — transport-agnostic request handlers.
+
+Parity: ``data/api/EventServer.scala`` (``EventServiceActor`` routes):
+
+* ``GET /``                          -> ``{"status": "alive"}``
+* ``POST /events.json``              -> 201 ``{"eventId": ...}``
+* ``GET /events/<id>.json``          -> 200 event | 404
+* ``DELETE /events/<id>.json``       -> 200 ``{"message": "Found"}`` | 404
+* ``GET /events.json``               -> 200 JSON array (time/entity filters)
+* ``POST /batch/events.json``        -> 200 per-item status array (max 50)
+* ``GET /stats.json``                -> live counters (when enabled)
+* ``POST /webhooks/<connector>.json``-> adapt third-party payloads
+
+Auth matches the reference: every data route needs ``accessKey`` (query
+param or ``Authorization`` header), resolved against the metadata store;
+an access key may whitelist event names; ``channel`` routes to a channel
+stream. Responses use the reference's JSON shapes so existing client SDKs
+keep working.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+from typing import Any, Mapping
+
+from predictionio_tpu.api.stats import Stats
+from predictionio_tpu.api.webhooks import (
+    ConnectorError,
+    FormConnector,
+    JsonConnector,
+    get_connector,
+)
+from predictionio_tpu.data.event import (
+    EventValidationError,
+    event_from_json,
+    event_to_json,
+    parse_event_time,
+    validate_event,
+)
+from predictionio_tpu.data.storage import Storage
+
+__all__ = ["Response", "EventService", "MAX_BATCH_SIZE"]
+
+logger = logging.getLogger(__name__)
+
+MAX_BATCH_SIZE = 50  # parity: reference rejects batches > 50
+
+
+@dataclasses.dataclass(frozen=True)
+class Response:
+    status: int
+    body: Any
+
+    def json_bytes(self) -> bytes:
+        return json.dumps(self.body, default=str).encode()
+
+
+def _msg(status: int, message: str) -> Response:
+    return Response(status, {"message": message})
+
+
+class EventService:
+    """One instance per server process; thread-safe through the storage
+    drivers' own locking (single-writer semantics per sqlite connection)."""
+
+    def __init__(self, stats: bool = False):
+        self.stats_enabled = stats
+        self.stats = Stats() if stats else None
+
+    # ---------------------------------------------------------------- auth
+    def _auth(
+        self, params: Mapping[str, str], headers: Mapping[str, str] | None = None
+    ) -> tuple[Any, Any] | Response:
+        """accessKey (+channel) -> (AccessKey, channel_id|None) or an error
+        Response (parity: the authenticate directive + channel resolve)."""
+        key = params.get("accessKey")
+        if not key and headers:
+            # SDKs may send the key as basic-auth username; header names
+            # are case-insensitive per HTTP
+            auth = next(
+                (v for k, v in headers.items() if k.lower() == "authorization"), ""
+            )
+            if auth.startswith("Basic "):
+                import base64
+
+                try:
+                    key = base64.b64decode(auth[6:]).decode().split(":", 1)[0]
+                except Exception:
+                    key = None
+        if not key:
+            return _msg(401, "Missing accessKey.")
+        access_key = Storage.get_meta_data_access_keys().get(key)
+        if access_key is None:
+            return _msg(401, "Invalid accessKey.")
+        channel_name = params.get("channel")
+        if not channel_name:
+            return access_key, None
+        channels = Storage.get_meta_data_channels().get_by_appid(access_key.appid)
+        for ch in channels:
+            if ch.name == channel_name:
+                return access_key, ch.id
+        return _msg(400, f"Invalid channel: {channel_name}")
+
+    # -------------------------------------------------------------- routes
+    def status(self) -> Response:
+        return Response(200, {"status": "alive"})
+
+    def create_event(
+        self,
+        body: Any,
+        params: Mapping[str, str],
+        headers: Mapping[str, str] | None = None,
+    ) -> Response:
+        auth = self._auth(params, headers)
+        if isinstance(auth, Response):
+            return auth
+        access_key, channel_id = auth
+        resp = self._insert_one(body, access_key, channel_id)
+        if self.stats is not None:
+            name = body.get("event") if isinstance(body, Mapping) else None
+            etype = body.get("entityType") if isinstance(body, Mapping) else None
+            self.stats.update(access_key.appid, resp.status, name, etype)
+        return resp
+
+    def _insert_one(self, body: Any, access_key, channel_id) -> Response:
+        if not isinstance(body, Mapping):
+            return _msg(400, "Event must be a JSON object.")
+        try:
+            event = event_from_json(body)
+        except EventValidationError as e:
+            return _msg(400, str(e))
+        if access_key.events and event.event not in access_key.events:
+            return _msg(403, f"Event '{event.event}' is not allowed by this accessKey.")
+        event_id = Storage.get_l_events().insert(event, access_key.appid, channel_id)
+        return Response(201, {"eventId": event_id})
+
+    def create_events_batch(
+        self,
+        body: Any,
+        params: Mapping[str, str],
+        headers: Mapping[str, str] | None = None,
+    ) -> Response:
+        auth = self._auth(params, headers)
+        if isinstance(auth, Response):
+            return auth
+        access_key, channel_id = auth
+        if not isinstance(body, list):
+            return _msg(400, "Batch events must be a JSON array.")
+        if len(body) > MAX_BATCH_SIZE:
+            return _msg(400, f"Batch size is greater than {MAX_BATCH_SIZE}.")
+        results = []
+        for item in body:
+            r = self._insert_one(item, access_key, channel_id)
+            entry = dict(r.body)
+            entry["status"] = r.status
+            results.append(entry)
+            if self.stats is not None:
+                name = item.get("event") if isinstance(item, Mapping) else None
+                etype = item.get("entityType") if isinstance(item, Mapping) else None
+                self.stats.update(access_key.appid, r.status, name, etype)
+        return Response(200, results)
+
+    def get_event(
+        self, event_id: str, params: Mapping[str, str], headers=None
+    ) -> Response:
+        auth = self._auth(params, headers)
+        if isinstance(auth, Response):
+            return auth
+        access_key, channel_id = auth
+        event = Storage.get_l_events().get(event_id, access_key.appid, channel_id)
+        if event is None:
+            return _msg(404, "Not Found")
+        return Response(200, event_to_json(event))
+
+    def delete_event(
+        self, event_id: str, params: Mapping[str, str], headers=None
+    ) -> Response:
+        auth = self._auth(params, headers)
+        if isinstance(auth, Response):
+            return auth
+        access_key, channel_id = auth
+        if Storage.get_l_events().delete(event_id, access_key.appid, channel_id):
+            return Response(200, {"message": "Found"})
+        return _msg(404, "Not Found")
+
+    def find_events(self, params: Mapping[str, str], headers=None) -> Response:
+        auth = self._auth(params, headers)
+        if isinstance(auth, Response):
+            return auth
+        access_key, channel_id = auth
+        try:
+            filters = self._parse_find_filters(params)
+        except (EventValidationError, ValueError) as e:
+            return _msg(400, str(e))
+        events = Storage.get_l_events().find(
+            access_key.appid, channel_id, **filters
+        )
+        return Response(200, [event_to_json(e) for e in events])
+
+    @staticmethod
+    def _parse_find_filters(params: Mapping[str, str]) -> dict[str, Any]:
+        filters: dict[str, Any] = {}
+        if params.get("startTime"):
+            filters["start_time"] = parse_event_time(params["startTime"])
+        if params.get("untilTime"):
+            filters["until_time"] = parse_event_time(params["untilTime"])
+        if params.get("entityType"):
+            filters["entity_type"] = params["entityType"]
+        if params.get("entityId"):
+            filters["entity_id"] = params["entityId"]
+        if params.get("event"):
+            filters["event_names"] = [params["event"]]
+        if params.get("targetEntityType"):
+            filters["target_entity_type"] = params["targetEntityType"]
+        if params.get("targetEntityId"):
+            filters["target_entity_id"] = params["targetEntityId"]
+        if params.get("limit"):
+            limit = int(params["limit"])
+            filters["limit"] = None if limit < 0 else limit
+        else:
+            filters["limit"] = 20  # reference default
+        if params.get("reversed"):
+            filters["reversed"] = params["reversed"].lower() == "true"
+        return filters
+
+    def get_stats(self, params: Mapping[str, str], headers=None) -> Response:
+        if self.stats is None:
+            return _msg(404, "Stats are not enabled (run with --stats).")
+        auth = self._auth(params, headers)
+        if isinstance(auth, Response):
+            return auth
+        return Response(200, self.stats.to_json())
+
+    def webhook(
+        self,
+        connector_name: str,
+        body: Any,
+        params: Mapping[str, str],
+        headers=None,
+        form: Mapping[str, str] | None = None,
+    ) -> Response:
+        auth = self._auth(params, headers)
+        if isinstance(auth, Response):
+            return auth
+        access_key, channel_id = auth
+        connector = get_connector(connector_name)
+        if connector is None:
+            return _msg(404, f"Unknown webhook connector '{connector_name}'.")
+        try:
+            if isinstance(connector, FormConnector):
+                event = connector.to_event(form or {})
+            else:
+                assert isinstance(connector, JsonConnector)
+                if not isinstance(body, Mapping):
+                    return _msg(400, "Webhook payload must be a JSON object.")
+                event = connector.to_event(body)
+            # connectors adapt shapes; the event-model invariants still
+            # apply on this write path like any other
+            validate_event(event)
+        except (ConnectorError, EventValidationError) as e:
+            return _msg(400, str(e))
+        event_id = Storage.get_l_events().insert(event, access_key.appid, channel_id)
+        return Response(201, {"eventId": event_id})
+
+    # ------------------------------------------------------------ dispatch
+    def dispatch(
+        self,
+        method: str,
+        path: str,
+        params: Mapping[str, str],
+        body: Any = None,
+        headers: Mapping[str, str] | None = None,
+        form: Mapping[str, str] | None = None,
+    ) -> Response:
+        """Route one request (shared by the HTTP wrapper and in-process
+        tests — the spray-testkit analog)."""
+        method = method.upper()
+        if path == "/" and method == "GET":
+            return self.status()
+        if path == "/events.json":
+            if method == "POST":
+                return self.create_event(body, params, headers)
+            if method == "GET":
+                return self.find_events(params, headers)
+        if path == "/batch/events.json" and method == "POST":
+            return self.create_events_batch(body, params, headers)
+        if path.startswith("/events/") and path.endswith(".json"):
+            event_id = path[len("/events/"):-len(".json")]
+            if method == "GET":
+                return self.get_event(event_id, params, headers)
+            if method == "DELETE":
+                return self.delete_event(event_id, params, headers)
+        if path == "/stats.json" and method == "GET":
+            return self.get_stats(params, headers)
+        if path.startswith("/webhooks/") and path.endswith(".json") and method == "POST":
+            name = path[len("/webhooks/"):-len(".json")]
+            return self.webhook(name, body, params, headers, form)
+        return _msg(404, "Not Found")

@@ -20,9 +20,15 @@ def _bucket(dt: _dt.datetime) -> _dt.datetime:
 
 
 class Stats:
-    def __init__(self):
+    #: retain at most this many (appId, minute) buckets; oldest evicted
+    #: first so a long-running server's memory and /stats.json response
+    #: stay bounded (~24h of single-app traffic).
+    MAX_BUCKETS = 1440
+
+    def __init__(self, max_buckets: int | None = None):
         self._lock = threading.Lock()
         self.start_time = _dt.datetime.now(_dt.timezone.utc)
+        self.max_buckets = max_buckets or self.MAX_BUCKETS
         # (appId, bucket) -> Counter keyed by ("status", code) /
         # ("event", name) / ("etype", entityType)
         self._counts: dict[tuple[int, _dt.datetime], Counter] = {}
@@ -37,6 +43,10 @@ class Stats:
     ) -> None:
         when = _bucket(when or _dt.datetime.now(_dt.timezone.utc))
         with self._lock:
+            if (app_id, when) not in self._counts:
+                while len(self._counts) >= self.max_buckets:
+                    oldest = min(self._counts, key=lambda k: k[1])
+                    del self._counts[oldest]
             c = self._counts.setdefault((app_id, when), Counter())
             c[("status", str(status_code))] += 1
             if event_name:

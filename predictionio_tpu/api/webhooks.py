@@ -54,9 +54,12 @@ class ExampleJsonConnector(JsonConnector):
     def to_event(self, payload: Mapping[str, Any]) -> Event:
         if payload.get("type") != "userAction":
             raise ConnectorError(f"Unsupported payload type: {payload.get('type')!r}")
-        if "userId" not in payload:
+        if not payload.get("userId"):
             raise ConnectorError("field 'userId' is required")
         target = payload.get("targetedItem")
+        kwargs = {}
+        if payload.get("timestamp"):
+            kwargs["event_time"] = parse_event_time(payload["timestamp"])
         return Event(
             event=str(payload.get("event", "userAction")),
             entity_type="user",
@@ -64,11 +67,7 @@ class ExampleJsonConnector(JsonConnector):
             target_entity_type="item" if target is not None else None,
             target_entity_id=str(target) if target is not None else None,
             properties=DataMap(payload.get("properties") or {}),
-            event_time=(
-                parse_event_time(payload["timestamp"])
-                if payload.get("timestamp")
-                else Event(event="x", entity_type="x", entity_id="x").event_time
-            ),
+            **kwargs,
         )
 
 
@@ -117,16 +116,15 @@ class SegmentIOConnector(JsonConnector):
         if kind == "track" and payload.get("event"):
             props["event"] = payload["event"]
         ts = payload.get("timestamp") or payload.get("sentAt")
+        kwargs = {}
+        if ts:
+            kwargs["event_time"] = parse_event_time(ts)
         return Event(
             event=kind,
             entity_type="user",
             entity_id=str(user),
             properties=DataMap(props),
-            event_time=(
-                parse_event_time(ts)
-                if ts
-                else Event(event="x", entity_type="x", entity_id="x").event_time
-            ),
+            **kwargs,
         )
 
 

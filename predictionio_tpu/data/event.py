@@ -46,6 +46,11 @@ RESERVED_EVENTS = frozenset({SET_EVENT, UNSET_EVENT, DELETE_EVENT})
 
 _RESERVED_PREFIXES = ("$", "pio_")
 
+#: pio_-prefixed entity types the server itself writes (parity: the
+#: reference's builtin entity types — the feedback loop records
+#: predictions as ``pio_pr`` entities).
+BUILTIN_ENTITY_TYPES = frozenset({"pio_user", "pio_item", "pio_pr"})
+
 
 class EventValidationError(ValueError):
     """Raised when an event violates the event-model invariants."""
@@ -246,8 +251,10 @@ def validate_event(event: Event) -> None:
             if label == "event" and value in RESERVED_EVENTS:
                 continue
             if label == "entityType" and not value.startswith("$"):
-                # pio_* entity types are reserved for internal bookkeeping but
-                # tolerated on read paths; reject on the write path.
+                if value in BUILTIN_ENTITY_TYPES:
+                    continue
+                # other pio_* entity types are reserved for internal
+                # bookkeeping; reject on the write path.
                 raise EventValidationError(f"{label} '{value}' is reserved (pio_ prefix)")
             if label == "event":
                 raise EventValidationError(

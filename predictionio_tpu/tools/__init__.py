@@ -1,0 +1,8 @@
+"""Ops CLI and tooling.
+
+Parity: ``tools/src/main/scala/org/apache/predictionio/tools/``
+(SURVEY.md section 3.6): the ``pio`` console, app/accesskey/channel
+management, import/export, status, and the train/deploy/eval launchers.
+Unlike the reference there is no spark-submit bridge (``Runner.scala``) —
+workflows run in-process on the TPU host.
+"""

@@ -1,0 +1,47 @@
+"""Batch prediction: JSON-lines queries in, JSON-lines results out.
+
+Parity: ``core/workflow/BatchPredict.scala`` (``pio batchpredict``) — load
+the trained instance like ``deploy`` does, then map the query file through
+the full supplement/predict/serve pipeline without binding an HTTP port.
+"""
+
+from __future__ import annotations
+
+import json
+
+from predictionio_tpu.workflow.engine_json import load_engine_variant
+from predictionio_tpu.workflow.serving import QueryService
+
+__all__ = ["run_batch_predict"]
+
+
+def run_batch_predict(
+    engine_json: str,
+    input_path: str,
+    output_path: str,
+    engine_instance_id: str | None = None,
+) -> int:
+    variant = load_engine_variant(engine_json)
+    service = QueryService(variant, instance_id=engine_instance_id)
+    n = 0
+    with open(input_path) as fin, open(output_path, "w") as fout:
+        for line_no, line in enumerate(fin, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                query = json.loads(line)
+            except json.JSONDecodeError as e:
+                raise ValueError(f"{input_path}:{line_no}: malformed JSON: {e}") from e
+            status, payload = service.handle_query(query)
+            fout.write(
+                json.dumps(
+                    {"query": query, "prediction": payload}
+                    if status == 200
+                    else {"query": query, "error": payload, "status": status},
+                    default=str,
+                )
+                + "\n"
+            )
+            n += 1
+    return n

@@ -1,0 +1,280 @@
+"""CLI command implementations (transport- and argparse-free).
+
+Parity: ``tools/console/App.scala``, ``AccessKey.scala``, ``Export.scala``,
+``Import.scala``, the status checks of ``Console.scala``, and the
+train/deploy orchestration of ``RunWorkflow.scala``/``RunServer.scala``.
+Each function returns data (and prints human output via the ``out``
+callback) so tests can drive them without capturing stdout.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Callable, Iterable
+
+from predictionio_tpu.data.event import event_from_json, event_to_json
+from predictionio_tpu.data.storage import Storage, StorageError
+from predictionio_tpu.data.storage.base import AccessKey, App, Channel
+
+__all__ = [
+    "app_new",
+    "app_list",
+    "app_show",
+    "app_delete",
+    "app_data_delete",
+    "channel_new",
+    "channel_delete",
+    "accesskey_new",
+    "accesskey_list",
+    "accesskey_delete",
+    "import_events",
+    "export_events",
+    "status_check",
+]
+
+Out = Callable[[str], None]
+
+
+def _print(line: str) -> None:
+    print(line)
+
+
+# ------------------------------------------------------------------- apps
+def app_new(
+    name: str, description: str | None = None, access_key: str = "", out: Out = _print
+) -> tuple[App, AccessKey]:
+    """``pio app new`` — create app, init its event stream, mint a key."""
+    apps = Storage.get_meta_data_apps()
+    if apps.get_by_name(name) is not None:
+        raise StorageError(f"App '{name}' already exists.")
+    app_id = apps.insert(App(id=0, name=name, description=description))
+    Storage.get_l_events().init(app_id)
+    key = Storage.get_meta_data_access_keys().insert(
+        AccessKey(key=access_key, appid=app_id)
+    )
+    app = apps.get(app_id)
+    out(f"Created a new app:")
+    out(f"      Name: {name}")
+    out(f"        ID: {app_id}")
+    out(f"Access Key: {key}")
+    return app, AccessKey(key=key, appid=app_id)
+
+
+def app_list(out: Out = _print) -> list[App]:
+    apps = sorted(Storage.get_meta_data_apps().get_all(), key=lambda a: a.name)
+    keys = Storage.get_meta_data_access_keys()
+    out(f"{'Name':<20} | {'ID':<4} | Access Key")
+    for app in apps:
+        app_keys = keys.get_by_appid(app.id)
+        first = app_keys[0].key if app_keys else ""
+        out(f"{app.name:<20} | {app.id:<4} | {first}")
+    out(f"Finished listing {len(apps)} app(s).")
+    return apps
+
+
+def app_show(name: str, out: Out = _print) -> dict:
+    app = Storage.get_meta_data_apps().get_by_name(name)
+    if app is None:
+        raise StorageError(f"App '{name}' does not exist.")
+    keys = Storage.get_meta_data_access_keys().get_by_appid(app.id)
+    channels = Storage.get_meta_data_channels().get_by_appid(app.id)
+    out(f"    App Name: {app.name}")
+    out(f"      App ID: {app.id}")
+    out(f" Description: {app.description or ''}")
+    for k in keys:
+        events = ",".join(k.events) if k.events else "(all)"
+        out(f"  Access Key: {k.key} | {events}")
+    for ch in channels:
+        out(f"     Channel: {ch.name} (id {ch.id})")
+    return {"app": app, "access_keys": keys, "channels": channels}
+
+
+def app_delete(name: str, out: Out = _print) -> None:
+    """``pio app delete`` — drop the app, its keys, channels, events."""
+    app = Storage.get_meta_data_apps().get_by_name(name)
+    if app is None:
+        raise StorageError(f"App '{name}' does not exist.")
+    le = Storage.get_l_events()
+    for ch in Storage.get_meta_data_channels().get_by_appid(app.id):
+        le.remove(app.id, ch.id)
+        Storage.get_meta_data_channels().delete(ch.id)
+    le.remove(app.id)
+    for k in Storage.get_meta_data_access_keys().get_by_appid(app.id):
+        Storage.get_meta_data_access_keys().delete(k.key)
+    Storage.get_meta_data_apps().delete(app.id)
+    out(f"Deleted app {name}.")
+
+
+def app_data_delete(name: str, channel: str | None = None, out: Out = _print) -> None:
+    """``pio app data-delete`` — wipe events, keep the app."""
+    app = Storage.get_meta_data_apps().get_by_name(name)
+    if app is None:
+        raise StorageError(f"App '{name}' does not exist.")
+    channel_id = None
+    if channel is not None:
+        matches = [
+            c for c in Storage.get_meta_data_channels().get_by_appid(app.id)
+            if c.name == channel
+        ]
+        if not matches:
+            raise StorageError(f"Channel '{channel}' does not exist.")
+        channel_id = matches[0].id
+    le = Storage.get_l_events()
+    le.remove(app.id, channel_id)
+    le.init(app.id, channel_id)
+    out(f"Deleted data of app {name}" + (f" channel {channel}." if channel else "."))
+
+
+# --------------------------------------------------------------- channels
+def channel_new(app_name: str, channel_name: str, out: Out = _print) -> Channel:
+    app = Storage.get_meta_data_apps().get_by_name(app_name)
+    if app is None:
+        raise StorageError(f"App '{app_name}' does not exist.")
+    if not Channel.is_valid_name(channel_name):
+        raise StorageError(f"Channel name {Channel.NAME_CONSTRAINT}.")
+    existing = Storage.get_meta_data_channels().get_by_appid(app.id)
+    if any(c.name == channel_name for c in existing):
+        raise StorageError(f"Channel '{channel_name}' already exists.")
+    ch_id = Storage.get_meta_data_channels().insert(
+        Channel(id=0, name=channel_name, appid=app.id)
+    )
+    Storage.get_l_events().init(app.id, ch_id)
+    out(f"Created channel {channel_name} (id {ch_id}) for app {app_name}.")
+    return Channel(id=ch_id, name=channel_name, appid=app.id)
+
+
+def channel_delete(app_name: str, channel_name: str, out: Out = _print) -> None:
+    app = Storage.get_meta_data_apps().get_by_name(app_name)
+    if app is None:
+        raise StorageError(f"App '{app_name}' does not exist.")
+    matches = [
+        c for c in Storage.get_meta_data_channels().get_by_appid(app.id)
+        if c.name == channel_name
+    ]
+    if not matches:
+        raise StorageError(f"Channel '{channel_name}' does not exist.")
+    Storage.get_l_events().remove(app.id, matches[0].id)
+    Storage.get_meta_data_channels().delete(matches[0].id)
+    out(f"Deleted channel {channel_name} of app {app_name}.")
+
+
+# ------------------------------------------------------------ access keys
+def accesskey_new(
+    app_name: str, events: Iterable[str] = (), key: str = "", out: Out = _print
+) -> str:
+    app = Storage.get_meta_data_apps().get_by_name(app_name)
+    if app is None:
+        raise StorageError(f"App '{app_name}' does not exist.")
+    new_key = Storage.get_meta_data_access_keys().insert(
+        AccessKey(key=key, appid=app.id, events=tuple(events))
+    )
+    out(f"Created new access key: {new_key}")
+    return new_key
+
+
+def accesskey_list(app_name: str | None = None, out: Out = _print) -> list[AccessKey]:
+    repo = Storage.get_meta_data_access_keys()
+    if app_name is None:
+        keys = repo.get_all()
+    else:
+        app = Storage.get_meta_data_apps().get_by_name(app_name)
+        if app is None:
+            raise StorageError(f"App '{app_name}' does not exist.")
+        keys = repo.get_by_appid(app.id)
+    for k in keys:
+        events = ",".join(k.events) if k.events else "(all)"
+        out(f"{k.key} | app {k.appid} | {events}")
+    out(f"Finished listing {len(keys)} access key(s).")
+    return keys
+
+
+def accesskey_delete(key: str, out: Out = _print) -> None:
+    if not Storage.get_meta_data_access_keys().delete(key):
+        raise StorageError(f"Access key '{key}' does not exist.")
+    out(f"Deleted access key {key}.")
+
+
+# ---------------------------------------------------------- import/export
+def import_events(
+    app_name: str,
+    input_path: str,
+    channel: str | None = None,
+    out: Out = _print,
+) -> int:
+    """``pio import`` — JSON-lines file -> event store bulk write
+    (parity: ``tools/imprt/FileToEvents.scala``)."""
+    from predictionio_tpu.data.store import resolve_app
+
+    app_id, channel_id = resolve_app(app_name, channel)
+    counter = {"n": 0}
+
+    def gen():
+        with open(input_path) as f:
+            for line_no, line in enumerate(f, 1):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    event = event_from_json(json.loads(line))
+                except Exception as e:
+                    raise StorageError(f"{input_path}:{line_no}: {e}") from e
+                counter["n"] += 1
+                yield event
+
+    Storage.get_p_events().write(gen(), app_id, channel_id)
+    out(f"Imported {counter['n']} events to app {app_name}.")
+    return counter["n"]
+
+
+def export_events(
+    app_name: str,
+    output_path: str,
+    channel: str | None = None,
+    out: Out = _print,
+) -> int:
+    """``pio export`` — event store -> JSON-lines file
+    (parity: ``tools/export/EventsToFile.scala``)."""
+    from predictionio_tpu.data.store import resolve_app
+
+    app_id, channel_id = resolve_app(app_name, channel)
+    n = 0
+    with open(output_path, "w") as f:
+        for event in Storage.get_p_events().find(app_id, channel_id):
+            f.write(json.dumps(event_to_json(event), default=str) + "\n")
+            n += 1
+    out(f"Exported {n} events to {output_path}.")
+    return n
+
+
+# ----------------------------------------------------------------- status
+def status_check(out: Out = _print) -> dict:
+    """``pio status`` — verify storage connectivity per repository role
+    (parity: the storage checks in ``Console.scala``)."""
+    import jax
+
+    results: dict[str, str] = {}
+    checks = [
+        ("metadata", lambda: Storage.get_meta_data_apps().get_all()),
+        ("eventdata", lambda: Storage.get_l_events()),
+        ("modeldata", lambda: Storage.get_model_data_models()),
+    ]
+    ok = True
+    for role, check in checks:
+        try:
+            check()
+            results[role] = "OK"
+        except Exception as e:  # surface the root cause, keep checking
+            results[role] = f"FAILED: {e}"
+            ok = False
+    try:
+        devices = jax.devices()
+        results["devices"] = f"{len(devices)} x {devices[0].platform}"
+    except Exception as e:
+        results["devices"] = f"FAILED: {e}"
+        ok = False
+    for role, status in results.items():
+        out(f"  {role:<10} {status}")
+    out("(sanity check) All systems go!" if ok else "Storage check FAILED")
+    results["ok"] = ok
+    return results

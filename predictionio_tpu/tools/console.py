@@ -1,0 +1,289 @@
+"""The ``pio`` console — argparse front-end over the command layer.
+
+Parity: ``tools/console/Console.scala`` + ``console/Pio.scala`` (scopt →
+argparse). Subcommand surface mirrors the reference:
+
+    pio version | status
+    pio app new|list|show|delete|data-delete|channel-new|channel-delete
+    pio accesskey new|list|delete
+    pio import|export
+    pio train | deploy | eval | eventserver | dashboard | batchpredict
+
+Run as ``python -m predictionio_tpu.tools.console`` or via ``bin/pio``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from predictionio_tpu.tools import commands
+from predictionio_tpu.version import __version__
+
+__all__ = ["main", "build_parser"]
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="pio", description="predictionio_tpu — TPU-native ML server"
+    )
+    sub = p.add_subparsers(dest="command", required=True)
+
+    sub.add_parser("version", help="print version")
+    sub.add_parser("status", help="check storage + device connectivity")
+
+    # ---- app
+    app = sub.add_parser("app", help="manage apps")
+    app_sub = app.add_subparsers(dest="app_command", required=True)
+    ap_new = app_sub.add_parser("new")
+    ap_new.add_argument("name")
+    ap_new.add_argument("--description")
+    ap_new.add_argument("--access-key", default="")
+    app_sub.add_parser("list")
+    for cmd in ("show", "delete", "data-delete"):
+        sp = app_sub.add_parser(cmd)
+        sp.add_argument("name")
+        if cmd == "data-delete":
+            sp.add_argument("--channel")
+    ch_new = app_sub.add_parser("channel-new")
+    ch_new.add_argument("name")
+    ch_new.add_argument("channel")
+    ch_del = app_sub.add_parser("channel-delete")
+    ch_del.add_argument("name")
+    ch_del.add_argument("channel")
+
+    # ---- accesskey
+    ak = sub.add_parser("accesskey", help="manage access keys")
+    ak_sub = ak.add_subparsers(dest="accesskey_command", required=True)
+    ak_new = ak_sub.add_parser("new")
+    ak_new.add_argument("app_name")
+    ak_new.add_argument("events", nargs="*")
+    ak_list = ak_sub.add_parser("list")
+    ak_list.add_argument("app_name", nargs="?")
+    ak_del = ak_sub.add_parser("delete")
+    ak_del.add_argument("key")
+
+    # ---- import / export
+    imp = sub.add_parser("import", help="bulk-load JSON-lines events")
+    imp.add_argument("--appname", required=True)
+    imp.add_argument("--input", required=True)
+    imp.add_argument("--channel")
+    exp = sub.add_parser("export", help="dump events to JSON-lines")
+    exp.add_argument("--appname", required=True)
+    exp.add_argument("--output", required=True)
+    exp.add_argument("--channel")
+
+    # ---- train
+    train = sub.add_parser("train", help="run the training workflow")
+    train.add_argument("--engine-json", default="engine.json")
+    train.add_argument("--batch", default="")
+    train.add_argument("--skip-sanity-check", action="store_true")
+    train.add_argument("--stop-after-read", action="store_true")
+    train.add_argument("--stop-after-prepare", action="store_true")
+    train.add_argument(
+        "--mesh",
+        default="auto",
+        help="'auto' (all devices on data axis), 'none' (local), or "
+        "'data=N,model=M' axis sizes",
+    )
+
+    # ---- deploy
+    deploy = sub.add_parser("deploy", help="serve the latest trained instance")
+    deploy.add_argument("--engine-json", default="engine.json")
+    deploy.add_argument("--ip", default="0.0.0.0")
+    deploy.add_argument("--port", type=int, default=8000)
+    deploy.add_argument("--engine-instance-id")
+    deploy.add_argument("--feedback", action="store_true")
+    deploy.add_argument("--event-server-ip", default="127.0.0.1")
+    deploy.add_argument("--event-server-port", type=int, default=7070)
+    deploy.add_argument("--accesskey", default="")
+
+    # ---- eval
+    ev = sub.add_parser("eval", help="run an evaluation sweep")
+    ev.add_argument("evaluation", help="import path of the Evaluation object")
+    ev.add_argument(
+        "params_generator",
+        nargs="?",
+        help="import path of the EngineParamsGenerator (optional if the "
+        "Evaluation supplies engine_params_list)",
+    )
+    ev.add_argument("--batch", default="")
+    ev.add_argument("--output-path", default="best.json")
+
+    # ---- eventserver
+    es = sub.add_parser("eventserver", help="start the event server")
+    es.add_argument("--ip", default="0.0.0.0")
+    es.add_argument("--port", type=int, default=7070)
+    es.add_argument("--stats", action="store_true")
+
+    # ---- dashboard
+    db = sub.add_parser("dashboard", help="start the evaluation dashboard")
+    db.add_argument("--ip", default="127.0.0.1")
+    db.add_argument("--port", type=int, default=9000)
+
+    # ---- batchpredict
+    bp = sub.add_parser("batchpredict", help="bulk predictions from a query file")
+    bp.add_argument("--engine-json", default="engine.json")
+    bp.add_argument("--input", required=True, help="JSON-lines query file")
+    bp.add_argument("--output", required=True, help="JSON-lines results file")
+    bp.add_argument("--engine-instance-id")
+
+    # ---- build (no-op parity)
+    sub.add_parser(
+        "build", help="no-op (Python engines need no compilation; kept for parity)"
+    )
+    return p
+
+
+def _parse_mesh(spec: str):
+    from predictionio_tpu.controller.context import local_context, mesh_context
+
+    if spec == "none":
+        return local_context()
+    if spec == "auto":
+        return mesh_context()
+    sizes = {}
+    for part in spec.split(","):
+        axis, _, n = part.partition("=")
+        sizes[axis.strip()] = int(n)
+    return mesh_context(
+        axis_sizes=list(sizes.values()), axis_names=list(sizes.keys())
+    )
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    cmd = args.command
+    try:
+        if cmd == "version":
+            print(__version__)
+        elif cmd == "status":
+            results = commands.status_check()
+            return 0 if results["ok"] else 1
+        elif cmd == "app":
+            ac = args.app_command
+            if ac == "new":
+                commands.app_new(args.name, args.description, args.access_key)
+            elif ac == "list":
+                commands.app_list()
+            elif ac == "show":
+                commands.app_show(args.name)
+            elif ac == "delete":
+                commands.app_delete(args.name)
+            elif ac == "data-delete":
+                commands.app_data_delete(args.name, args.channel)
+            elif ac == "channel-new":
+                commands.channel_new(args.name, args.channel)
+            elif ac == "channel-delete":
+                commands.channel_delete(args.name, args.channel)
+        elif cmd == "accesskey":
+            akc = args.accesskey_command
+            if akc == "new":
+                commands.accesskey_new(args.app_name, args.events)
+            elif akc == "list":
+                commands.accesskey_list(args.app_name)
+            elif akc == "delete":
+                commands.accesskey_delete(args.key)
+        elif cmd == "import":
+            commands.import_events(args.appname, args.input, args.channel)
+        elif cmd == "export":
+            commands.export_events(args.appname, args.output, args.channel)
+        elif cmd == "train":
+            from predictionio_tpu.workflow import load_engine_variant, run_train
+            from predictionio_tpu.workflow.core import WorkflowParams
+
+            variant = load_engine_variant(args.engine_json)
+            ctx = _parse_mesh(args.mesh)
+            instance = run_train(
+                variant,
+                ctx,
+                WorkflowParams(
+                    batch=args.batch,
+                    skip_sanity_check=args.skip_sanity_check,
+                    stop_after_read=args.stop_after_read,
+                    stop_after_prepare=args.stop_after_prepare,
+                ),
+            )
+            print(f"Training completed. Engine instance: {instance.id}")
+        elif cmd == "deploy":
+            from predictionio_tpu.api.http import serve
+            from predictionio_tpu.workflow import load_engine_variant
+            from predictionio_tpu.workflow.serving import FeedbackConfig, QueryService
+
+            variant = load_engine_variant(args.engine_json)
+            feedback = None
+            if args.feedback:
+                feedback = FeedbackConfig(
+                    event_server_url=(
+                        f"http://{args.event_server_ip}:{args.event_server_port}"
+                    ),
+                    access_key=args.accesskey,
+                )
+            service = QueryService(
+                variant, feedback=feedback, instance_id=args.engine_instance_id
+            )
+            print(f"Engine is deployed and running. Listening on {args.ip}:{args.port}")
+            serve(service.dispatch, args.ip, args.port)
+        elif cmd == "eval":
+            from predictionio_tpu.controller import local_context
+            from predictionio_tpu.controller.evaluation import EngineParamsGenerator
+            from predictionio_tpu.utils.reflection import resolve_attr
+            from predictionio_tpu.workflow.core import WorkflowParams, run_evaluation
+
+            evaluation = resolve_attr(args.evaluation)
+            if callable(evaluation) and not hasattr(evaluation, "engine"):
+                evaluation = evaluation()
+            if args.params_generator:
+                generator = resolve_attr(args.params_generator)
+                if callable(generator) and not hasattr(generator, "engine_params_list"):
+                    generator = generator()
+            else:
+                generator = EngineParamsGenerator(
+                    getattr(evaluation, "engine_params_list", ())
+                )
+            instance, result = run_evaluation(
+                evaluation,
+                generator,
+                local_context(),
+                WorkflowParams(batch=args.batch),
+                evaluation_class=args.evaluation,
+                generator_class=args.params_generator or "",
+            )
+            print(result.leaderboard())
+            with open(args.output_path, "w") as f:
+                json.dump(result.to_json(), f, indent=2, default=str)
+            print(f"Best params written to {args.output_path}")
+        elif cmd == "eventserver":
+            from predictionio_tpu.api import EventService
+            from predictionio_tpu.api.http import serve
+
+            service = EventService(stats=args.stats)
+            print(f"Event Server is listening on {args.ip}:{args.port}")
+            serve(service.dispatch, args.ip, args.port)
+        elif cmd == "dashboard":
+            from predictionio_tpu.api.http import serve
+            from predictionio_tpu.tools.dashboard import DashboardService
+
+            print(f"Dashboard is listening on {args.ip}:{args.port}")
+            serve(DashboardService().dispatch, args.ip, args.port)
+        elif cmd == "batchpredict":
+            from predictionio_tpu.tools.batchpredict import run_batch_predict
+
+            n = run_batch_predict(
+                args.engine_json, args.input, args.output, args.engine_instance_id
+            )
+            print(f"Wrote {n} predictions to {args.output}")
+        elif cmd == "build":
+            print(
+                "Nothing to build: Python engines are imported directly. "
+                "(kept for command-line parity with the reference)"
+            )
+        return 0
+    except Exception as e:
+        print(f"ERROR: {e}", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

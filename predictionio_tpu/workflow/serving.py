@@ -1,0 +1,267 @@
+"""Query server — deploy a trained engine instance behind HTTP.
+
+Parity: ``core/workflow/CreateServer.scala`` (``MasterActor`` +
+``ServerActor``): load the latest COMPLETED ``EngineInstance``, re-hydrate
+models (``Engine.prepareDeploy``), answer ``POST /queries.json``, hot-swap
+on ``POST /reload``, status on ``GET /``, plugin dispatch, and the
+optional feedback loop that writes prediction events back to the event
+server. The actor pair collapses into :class:`QueryService` — model state
+swaps are a single attribute assignment behind a lock, and jit warm-up
+happens at (re)load time so first queries pay no compile.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime as _dt
+import json
+import logging
+import threading
+import urllib.request
+import uuid
+from typing import Any, Mapping, Sequence
+
+from predictionio_tpu.controller.context import WorkflowContext, local_context
+from predictionio_tpu.controller.engine import Engine
+from predictionio_tpu.controller.params import params_from_json, params_to_json
+from predictionio_tpu.data.storage import Storage
+from predictionio_tpu.workflow.engine_json import EngineVariant
+
+__all__ = [
+    "EngineServerPlugin",
+    "QueryService",
+    "FeedbackConfig",
+    "QueryServerError",
+]
+
+logger = logging.getLogger(__name__)
+
+
+class QueryServerError(RuntimeError):
+    pass
+
+
+class EngineServerPlugin:
+    """Serving-side plugin (parity: ``core/workflow/EngineServerPlugin.scala``).
+
+    ``plugin_type`` is ``"outputblocker"`` (may rewrite the response) or
+    ``"outputsniffer"`` (observes only). ``process`` receives and returns
+    the JSON-ready prediction payload.
+    """
+
+    plugin_type = "outputsniffer"
+    name = "plugin"
+
+    def start(self, service: "QueryService") -> None:  # lifecycle hook
+        pass
+
+    def process(self, query: Any, prediction: Any, service: "QueryService") -> Any:
+        return prediction
+
+
+@dataclasses.dataclass(frozen=True)
+class FeedbackConfig:
+    """Feedback-loop settings (parity: ``--feedback --event-server-*``)."""
+
+    event_server_url: str  # e.g. http://127.0.0.1:7070
+    access_key: str
+    channel: str | None = None
+
+
+def _result_to_json(result: Any) -> Any:
+    if hasattr(result, "to_json"):
+        return result.to_json()
+    if dataclasses.is_dataclass(result) and not isinstance(result, type):
+        return dataclasses.asdict(result)
+    return result
+
+
+class QueryService:
+    """One deployed engine instance (thread-safe; hot-reloadable)."""
+
+    def __init__(
+        self,
+        variant: EngineVariant,
+        ctx: WorkflowContext | None = None,
+        plugins: Sequence[EngineServerPlugin] = (),
+        feedback: FeedbackConfig | None = None,
+        instance_id: str | None = None,
+    ):
+        self.variant = variant
+        self.ctx = ctx or local_context()
+        self.plugins = list(plugins)
+        self.feedback = feedback
+        self._requested_instance_id = instance_id
+        self._lock = threading.Lock()
+        self._engine: Engine | None = None
+        self._serving = None
+        self._algo_model_pairs: list = []
+        self.instance = None
+        self.start_time = _dt.datetime.now(_dt.timezone.utc)
+        self.query_count = 0
+        self.reload()
+        for p in self.plugins:
+            p.start(self)
+
+    # ---------------------------------------------------------------- load
+    def _resolve_instance(self):
+        repo = Storage.get_meta_data_engine_instances()
+        if self._requested_instance_id:
+            inst = repo.get(self._requested_instance_id)
+            if inst is None:
+                raise QueryServerError(
+                    f"Engine instance '{self._requested_instance_id}' not found"
+                )
+            return inst
+        inst = repo.get_latest_completed(
+            self.variant.id, self.variant.version, self.variant.id
+        )
+        if inst is None:
+            raise QueryServerError(
+                f"No COMPLETED training of engine '{self.variant.id}' "
+                f"(version '{self.variant.version}') found — run `pio train` first"
+            )
+        return inst
+
+    def reload(self) -> None:
+        """(Re)hydrate engine + models — the ``/reload`` hot swap
+        (parity: MasterActor re-running prepareDeploy)."""
+        instance = self._resolve_instance()
+        engine = self.variant.build_engine()
+        engine_params = engine.params_from_json(
+            {
+                "datasource": {"params": json.loads(instance.datasource_params or "{}")},
+                "preparator": {"params": json.loads(instance.preparator_params or "{}")},
+                "algorithms": json.loads(instance.algorithms_params or "[]"),
+                "serving": {"params": json.loads(instance.serving_params or "{}")},
+            }
+            if instance.algorithms_params
+            else self.variant.raw
+        )
+        model = Storage.get_model_data_models().get(instance.id)
+        if model is None:
+            raise QueryServerError(f"No model blob for instance '{instance.id}'")
+        serving, pairs = engine.prepare_deploy(
+            self.ctx, engine_params, instance.id, model.models
+        )
+        with self._lock:
+            self._engine = engine
+            self._serving = serving
+            self._algo_model_pairs = pairs
+            self.instance = instance
+        logger.info("Loaded engine instance %s", instance.id)
+
+    # --------------------------------------------------------------- query
+    @staticmethod
+    def _bind_query(body: Any, pairs: Sequence) -> Any:
+        algo = pairs[0][0]
+        query_class = getattr(algo, "query_class", None)
+        if query_class is None or not isinstance(body, Mapping):
+            return body
+        return params_from_json(query_class, body)
+
+    def handle_query(self, body: Any) -> tuple[int, Any]:
+        # snapshot under the lock so an in-flight query is internally
+        # consistent across a concurrent /reload hot-swap
+        with self._lock:
+            serving = self._serving
+            pairs = list(self._algo_model_pairs)
+        if serving is None:
+            return 503, {"message": "No engine loaded"}
+        try:
+            query = self._bind_query(body, pairs)
+        except Exception as e:
+            return 400, {"message": f"Invalid query: {e}"}
+        query = serving.supplement_base(query)
+        predictions = [algo.predict_base(model, query) for algo, model in pairs]
+        result = serving.serve_base(query, predictions)
+        payload = _result_to_json(result)
+        pr_id = None
+        if self.feedback is not None:
+            pr_id = uuid.uuid4().hex
+            if isinstance(payload, dict):
+                payload = dict(payload, prId=pr_id)
+        for plugin in self.plugins:
+            if plugin.plugin_type == "outputblocker":
+                payload = plugin.process(query, payload, self)
+            else:
+                plugin.process(query, payload, self)
+        if self.feedback is not None:
+            self._send_feedback(body, payload, pr_id)
+        with self._lock:
+            self.query_count += 1
+        return 200, payload
+
+    # ------------------------------------------------------------ feedback
+    def _send_feedback(self, query_body: Any, payload: Any, pr_id: str | None) -> None:
+        """Async POST of the prediction as a ``predict`` event
+        (parity: the feedback loop in CreateServer)."""
+        fb = self.feedback
+        assert fb is not None
+        event = {
+            "event": "predict",
+            "entityType": "pio_pr",
+            "entityId": pr_id or "",
+            "properties": {"query": query_body, "prediction": payload},
+            "prId": pr_id,
+            "eventTime": _dt.datetime.now(_dt.timezone.utc).isoformat(),
+        }
+        url = f"{fb.event_server_url.rstrip('/')}/events.json?accessKey={fb.access_key}"
+        if fb.channel:
+            url += f"&channel={fb.channel}"
+
+        def post():
+            try:
+                req = urllib.request.Request(
+                    url,
+                    data=json.dumps(event, default=str).encode(),
+                    headers={"Content-Type": "application/json"},
+                    method="POST",
+                )
+                urllib.request.urlopen(req, timeout=5).read()
+            except Exception:
+                logger.exception("Feedback POST failed")
+
+        threading.Thread(target=post, daemon=True).start()
+
+    # -------------------------------------------------------------- status
+    def status_json(self) -> dict:
+        inst = self.instance
+        return {
+            "status": "alive",
+            "engineId": self.variant.id,
+            "engineVersion": self.variant.version,
+            "engineFactory": self.variant.engine_factory,
+            "engineInstanceId": inst.id if inst else None,
+            "startTime": self.start_time.isoformat(),
+            "queryCount": self.query_count,
+            "plugins": [
+                {"name": p.name, "type": p.plugin_type} for p in self.plugins
+            ],
+        }
+
+    # ------------------------------------------------------------ dispatch
+    def dispatch(
+        self,
+        method: str,
+        path: str,
+        params: Mapping[str, str],
+        body: Any = None,
+        headers: Mapping[str, str] | None = None,
+        form: Mapping[str, str] | None = None,
+    ):
+        from predictionio_tpu.api.service import Response
+
+        method = method.upper()
+        if path == "/" and method == "GET":
+            return Response(200, self.status_json())
+        if path == "/queries.json" and method == "POST":
+            status, payload = self.handle_query(body)
+            return Response(status, payload)
+        if path == "/reload" and method == "POST":
+            try:
+                self.reload()
+                return Response(200, {"message": "Reloaded"})
+            except QueryServerError as e:
+                return Response(500, {"message": str(e)})
+        return Response(404, {"message": "Not Found"})

@@ -1,0 +1,47 @@
+"""Dashboard tests: evaluations listing as JSON and HTML."""
+
+from predictionio_tpu.controller import (
+    EngineParams,
+    EngineParamsGenerator,
+    Evaluation,
+    AverageMetric,
+    FirstServing,
+    local_context,
+)
+from predictionio_tpu.tools.dashboard import DashboardService
+from predictionio_tpu.workflow import run_evaluation
+
+from fake_dase import AlgoParams, DSParams, engine0
+
+
+class MAE(AverageMetric):
+    def calculate_unit(self, q, p, a):
+        return -abs(p - a)
+
+
+def _run_one_eval():
+    eng = engine0()
+    eng.serving_class = FirstServing
+    candidates = [
+        EngineParams(datasource=DSParams(), algorithms=(("a0", AlgoParams(mult=1)),))
+    ]
+    return run_evaluation(
+        Evaluation(engine=eng, metric=MAE()),
+        EngineParamsGenerator(candidates),
+        local_context(),
+    )
+
+
+def test_dashboard_lists_evaluations(memory_storage_env):
+    instance, _ = _run_one_eval()
+    svc = DashboardService()
+    r = svc.dispatch("GET", "/evaluations.json", {})
+    assert r.status == 200
+    assert r.body[0]["id"] == instance.id
+    assert r.body[0]["result"]["bestIdx"] == 0
+    html_resp = svc.dispatch("GET", "/", {})
+    assert html_resp.status == 200
+    page = html_resp.json_bytes().decode()
+    assert "Evaluation Dashboard" in page and instance.id in page
+    assert svc.dispatch("GET", "/nope", {}).status == 404
+    assert svc.dispatch("POST", "/", {}).status == 404

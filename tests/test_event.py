@@ -102,7 +102,10 @@ class TestValidation:
         with pytest.raises(EventValidationError):
             validate_event(Event(event="$bogus", entity_type="user", entity_id="u1"))
         with pytest.raises(EventValidationError):
-            validate_event(Event(event="rate", entity_type="pio_user", entity_id="u1"))
+            validate_event(Event(event="rate", entity_type="pio_other", entity_id="u1"))
+        # builtin pio_ entity types are allowed (feedback loop writes pio_pr)
+        validate_event(Event(event="predict", entity_type="pio_pr", entity_id="p1"))
+        validate_event(Event(event="rate", entity_type="pio_user", entity_id="u1"))
 
     def test_special_event_rules(self):
         with pytest.raises(EventValidationError):  # $unset needs properties

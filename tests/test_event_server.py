@@ -1,0 +1,229 @@
+"""Event-server tests — in-process dispatch (spray-testkit analog) plus one
+real HTTP round trip through the stdlib wrapper."""
+
+import json
+import urllib.request
+
+import pytest
+
+from predictionio_tpu.api import EventService
+from predictionio_tpu.data.storage.base import AccessKey, App, Channel
+
+
+@pytest.fixture()
+def service_env(memory_storage_env):
+    Storage = memory_storage_env
+    app_id = Storage.get_meta_data_apps().insert(App(id=0, name="evapp"))
+    key = Storage.get_meta_data_access_keys().insert(AccessKey(key="", appid=app_id))
+    Storage.get_l_events().init(app_id)
+    ch_id = Storage.get_meta_data_channels().insert(
+        Channel(id=0, name="backchannel", appid=app_id)
+    )
+    Storage.get_l_events().init(app_id, ch_id)
+    return Storage, app_id, key
+
+
+EV = {
+    "event": "rate",
+    "entityType": "user",
+    "entityId": "u1",
+    "targetEntityType": "item",
+    "targetEntityId": "i1",
+    "properties": {"rating": 5.0},
+}
+
+
+class TestEventRoutes:
+    def test_status(self, service_env):
+        svc = EventService()
+        r = svc.dispatch("GET", "/", {})
+        assert r.status == 200 and r.body == {"status": "alive"}
+
+    def test_create_get_delete_round_trip(self, service_env):
+        _, _, key = service_env
+        svc = EventService()
+        r = svc.dispatch("POST", "/events.json", {"accessKey": key}, EV)
+        assert r.status == 201
+        event_id = r.body["eventId"]
+        r2 = svc.dispatch("GET", f"/events/{event_id}.json", {"accessKey": key})
+        assert r2.status == 200
+        assert r2.body["event"] == "rate"
+        assert r2.body["entityId"] == "u1"
+        assert r2.body["properties"] == {"rating": 5.0}
+        r3 = svc.dispatch("DELETE", f"/events/{event_id}.json", {"accessKey": key})
+        assert r3.status == 200 and r3.body == {"message": "Found"}
+        r4 = svc.dispatch("GET", f"/events/{event_id}.json", {"accessKey": key})
+        assert r4.status == 404
+
+    def test_auth_required_and_invalid(self, service_env):
+        svc = EventService()
+        assert svc.dispatch("POST", "/events.json", {}, EV).status == 401
+        assert (
+            svc.dispatch("POST", "/events.json", {"accessKey": "wrong"}, EV).status
+            == 401
+        )
+
+    def test_event_whitelist(self, service_env):
+        Storage, app_id, _ = service_env
+        limited = Storage.get_meta_data_access_keys().insert(
+            AccessKey(key="", appid=app_id, events=("view",))
+        )
+        svc = EventService()
+        r = svc.dispatch("POST", "/events.json", {"accessKey": limited}, EV)
+        assert r.status == 403
+
+    def test_validation_errors_are_400(self, service_env):
+        _, _, key = service_env
+        svc = EventService()
+        bad = dict(EV, event="$badname")
+        r = svc.dispatch("POST", "/events.json", {"accessKey": key}, bad)
+        assert r.status == 400
+
+    def test_channel_routing_isolates_streams(self, service_env):
+        _, _, key = service_env
+        svc = EventService()
+        svc.dispatch(
+            "POST", "/events.json", {"accessKey": key, "channel": "backchannel"}, EV
+        )
+        main = svc.dispatch("GET", "/events.json", {"accessKey": key})
+        chan = svc.dispatch(
+            "GET", "/events.json", {"accessKey": key, "channel": "backchannel"}
+        )
+        assert main.body == []
+        assert len(chan.body) == 1
+
+    def test_unknown_channel_is_400(self, service_env):
+        _, _, key = service_env
+        svc = EventService()
+        r = svc.dispatch(
+            "POST", "/events.json", {"accessKey": key, "channel": "nope"}, EV
+        )
+        assert r.status == 400
+
+    def test_find_with_filters(self, service_env):
+        _, _, key = service_env
+        svc = EventService()
+        for u, name in [("u1", "rate"), ("u1", "view"), ("u2", "rate")]:
+            svc.dispatch(
+                "POST",
+                "/events.json",
+                {"accessKey": key},
+                dict(EV, entityId=u, event=name),
+            )
+        r = svc.dispatch(
+            "GET", "/events.json", {"accessKey": key, "event": "rate", "entityId": "u1",
+                                     "entityType": "user"},
+        )
+        assert r.status == 200 and len(r.body) == 1
+        # default limit 20; explicit limit
+        r2 = svc.dispatch("GET", "/events.json", {"accessKey": key, "limit": "2"})
+        assert len(r2.body) == 2
+
+    def test_batch(self, service_env):
+        _, _, key = service_env
+        svc = EventService()
+        batch = [EV, dict(EV, event="$badname"), dict(EV, entityId="u9")]
+        r = svc.dispatch("POST", "/batch/events.json", {"accessKey": key}, batch)
+        assert r.status == 200
+        statuses = [item["status"] for item in r.body]
+        assert statuses == [201, 400, 201]
+        too_many = [EV] * 51
+        assert (
+            svc.dispatch("POST", "/batch/events.json", {"accessKey": key}, too_many).status
+            == 400
+        )
+
+    def test_stats(self, service_env):
+        _, _, key = service_env
+        svc = EventService(stats=True)
+        svc.dispatch("POST", "/events.json", {"accessKey": key}, EV)
+        r = svc.dispatch("GET", "/stats.json", {"accessKey": key})
+        assert r.status == 200
+        assert r.body["statsByMinute"][0]["status"]["201"] == 1
+        assert r.body["statsByMinute"][0]["event"]["rate"] == 1
+        # disabled by default
+        assert EventService().dispatch("GET", "/stats.json", {"accessKey": key}).status == 404
+
+
+class TestWebhooks:
+    def test_examplejson(self, service_env):
+        _, _, key = service_env
+        svc = EventService()
+        payload = {"type": "userAction", "userId": "u7", "targetedItem": "i3",
+                   "properties": {"x": 1}}
+        r = svc.dispatch("POST", "/webhooks/examplejson.json", {"accessKey": key}, payload)
+        assert r.status == 201
+        found = svc.dispatch("GET", "/events.json", {"accessKey": key})
+        assert found.body[0]["entityId"] == "u7"
+        assert found.body[0]["targetEntityId"] == "i3"
+
+    def test_segmentio(self, service_env):
+        _, _, key = service_env
+        svc = EventService()
+        payload = {"type": "track", "userId": "u1", "event": "Signed Up",
+                   "properties": {"plan": "pro"}}
+        r = svc.dispatch("POST", "/webhooks/segmentio.json", {"accessKey": key}, payload)
+        assert r.status == 201
+
+    def test_mailchimp_form(self, service_env):
+        _, _, key = service_env
+        svc = EventService()
+        form = {"type": "subscribe", "data[email]": "a@b.c", "data[list_id]": "L1"}
+        r = svc.dispatch(
+            "POST", "/webhooks/mailchimp.json", {"accessKey": key}, None, None, form
+        )
+        assert r.status == 201
+
+    def test_invalid_webhook_payloads_are_400_not_500(self, service_env):
+        _, _, key = service_env
+        svc = EventService()
+        # empty userId -> connector/validation error, not a stored event
+        r1 = svc.dispatch(
+            "POST", "/webhooks/examplejson.json", {"accessKey": key},
+            {"type": "userAction", "userId": ""},
+        )
+        assert r1.status == 400
+        # malformed timestamp raises EventValidationError inside the
+        # connector; must surface as 400, not 500
+        r2 = svc.dispatch(
+            "POST", "/webhooks/examplejson.json", {"accessKey": key},
+            {"type": "userAction", "userId": "u1", "timestamp": "not-a-date"},
+        )
+        assert r2.status == 400
+        assert svc.dispatch("GET", "/events.json", {"accessKey": key}).body == []
+
+    def test_unknown_connector_404_bad_payload_400(self, service_env):
+        _, _, key = service_env
+        svc = EventService()
+        assert svc.dispatch("POST", "/webhooks/zzz.json", {"accessKey": key}, {}).status == 404
+        assert (
+            svc.dispatch("POST", "/webhooks/examplejson.json", {"accessKey": key}, {"type": "?"}).status
+            == 400
+        )
+
+
+class TestRealHTTP:
+    def test_http_round_trip(self, service_env):
+        from predictionio_tpu.api.http import start_background
+
+        _, _, key = service_env
+        svc = EventService()
+        server, _ = start_background(svc.dispatch)
+        port = server.server_address[1]
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/events.json?accessKey={key}",
+                data=json.dumps(EV).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            with urllib.request.urlopen(req) as resp:
+                assert resp.status == 201
+                event_id = json.loads(resp.read())["eventId"]
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/events/{event_id}.json?accessKey={key}"
+            ) as resp:
+                assert resp.status == 200
+                assert json.loads(resp.read())["entityId"] == "u1"
+        finally:
+            server.shutdown()
