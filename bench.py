@@ -1,0 +1,132 @@
+"""Benchmark: ALS training throughput (ratings/sec) on the flagship
+Recommendation workload.
+
+Prints ONE JSON line:
+    {"metric": "...", "value": N, "unit": "...", "vs_baseline": N}
+
+Workload: MovieLens-20M-shaped synthetic ratings (138k users x 27k items,
+20M ratings by default; scaled down automatically on CPU-only hosts).
+``vs_baseline``: the reference publishes no numbers (BASELINE.md), and no
+Spark is available in this image, so the denominator is the same JAX ALS
+run on host CPU — a strict stand-in for the reference's CPU compute path;
+the BASELINE.md north-star target is >=10x.
+
+Env knobs: BENCH_NNZ (default 20_000_000 on TPU), BENCH_RANK (64),
+BENCH_ITERS (3 timed sweeps).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def _make_workload(nnz: int, num_users: int, num_items: int, seed: int = 0):
+    """Zipf-ish synthetic ratings with MovieLens-like skew."""
+    rng = np.random.default_rng(seed)
+    # popularity skew: sample items by a power-law, users ~uniform-ish
+    item_p = (1.0 / np.arange(1, num_items + 1) ** 0.8)
+    item_p /= item_p.sum()
+    rows = rng.integers(0, num_users, size=nnz).astype(np.int64)
+    cols = rng.choice(num_items, size=nnz, p=item_p).astype(np.int64)
+    vals = rng.integers(1, 11, size=nnz).astype(np.float32) / 2.0  # 0.5..5.0
+    return rows, cols, vals
+
+
+def _time_training(rows, cols, vals, num_users, num_items, rank, iters, mesh):
+    import jax
+
+    from predictionio_tpu.ops.als import ALSConfig, als_sweep, build_buckets, train_als
+
+    # use train_als internals directly so warm-up (compile) is excluded
+    from predictionio_tpu.ops.als import _device_buckets
+
+    row_multiple = 8 if mesh is None else int(np.lcm(8, mesh.shape.get("data", 1)))
+    user_b = build_buckets(rows, cols, vals, num_users, num_items, row_multiple=row_multiple)
+    item_b = build_buckets(cols, rows, vals, num_items, num_users, row_multiple=row_multiple)
+    key_u, key_i = jax.random.split(jax.random.PRNGKey(0))
+    rank_scale = 1.0 / np.sqrt(rank)
+    uf = jax.numpy.abs(jax.random.normal(key_u, (num_users + 1, rank))) * rank_scale
+    vf = jax.numpy.abs(jax.random.normal(key_i, (num_items + 1, rank))) * rank_scale
+    user_buckets = _device_buckets(user_b, mesh, "data")
+    item_buckets = _device_buckets(item_b, mesh, "data")
+
+    def sweep(u, v):
+        return als_sweep(
+            u, v, user_buckets, item_buckets,
+            reg=0.05, implicit=False, alpha=1.0,
+            mesh=mesh, data_axis="data" if mesh is not None else None,
+        )
+
+    uf, vf = sweep(uf, vf)  # warm-up (compile)
+    float(jax.numpy.sum(uf))  # hard sync: host materialization
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        uf, vf = sweep(uf, vf)
+    # hard sync again — block_until_ready alone can be unreliable through
+    # remote-execution platforms; a host read cannot complete early
+    checksum = float(jax.numpy.sum(uf))
+    dt = time.perf_counter() - t0
+    assert np.isfinite(checksum)
+    return len(vals) * iters / dt  # ratings/sec (full sweeps)
+
+
+def main() -> None:
+    import jax
+
+    platform = jax.devices()[0].platform
+    on_accel = platform not in ("cpu",)
+    nnz = int(os.environ.get("BENCH_NNZ", 20_000_000 if on_accel else 500_000))
+    rank = int(os.environ.get("BENCH_RANK", 64))
+    iters = int(os.environ.get("BENCH_ITERS", 3))
+    num_users = max(1000, int(nnz / 145))  # ML-20M ratio ~145 ratings/user
+    num_items = max(500, int(nnz / 740))  # ~740 ratings/item
+
+    rows, cols, vals = _make_workload(nnz, num_users, num_items)
+    accel_tput = _time_training(
+        rows, cols, vals, num_users, num_items, rank, iters, mesh=None
+    )
+
+    # CPU baseline: same kernels on host CPU over a subsample, 1 iteration
+    # (throughput is ~size-independent; keeps bench wall-clock bounded)
+    vs_baseline = None
+    try:
+        cpu_dev = jax.devices("cpu")
+    except RuntimeError:
+        cpu_dev = []
+    if on_accel and cpu_dev:
+        sub = min(nnz, 1_000_000)
+        with jax.default_device(cpu_dev[0]):
+            cpu_tput = _time_training(
+                rows[:sub], cols[:sub], vals[:sub],
+                num_users, num_items, rank, 1, mesh=None,
+            )
+        vs_baseline = accel_tput / cpu_tput
+    print(
+        json.dumps(
+            {
+                "metric": f"als_train_throughput_{platform}",
+                "value": round(accel_tput, 1),
+                "unit": "ratings/sec",
+                "vs_baseline": round(vs_baseline, 2) if vs_baseline else None,
+                "detail": {
+                    "nnz": nnz,
+                    "rank": rank,
+                    "users": num_users,
+                    "items": num_items,
+                    "timed_iterations": iters,
+                    "baseline": "same JAX ALS on host CPU (1M-rating subsample)"
+                    if vs_baseline
+                    else "n/a (no accelerator)",
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -42,15 +42,19 @@ def _make_handler(dispatch: Dispatcher):
             raw = self.rfile.read(length) if length else b""
             ctype = (self.headers.get("Content-Type") or "").split(";")[0].strip()
             if raw:
-                if ctype == "application/x-www-form-urlencoded":
-                    form = {
-                        k: v[0]
-                        for k, v in urllib.parse.parse_qs(raw.decode()).items()
-                    }
-                else:
-                    try:
-                        body = json.loads(raw)
-                    except json.JSONDecodeError:
+                # Tolerant parse: clients (e.g. bare `curl -d`) often send
+                # JSON under a form-encoded default content type. Try JSON
+                # first for any body; fall back to form fields only when
+                # the payload isn't JSON and the content type says form.
+                try:
+                    body = json.loads(raw)
+                except json.JSONDecodeError:
+                    if ctype == "application/x-www-form-urlencoded":
+                        form = {
+                            k: v[0]
+                            for k, v in urllib.parse.parse_qs(raw.decode()).items()
+                        }
+                    else:
                         self._send(400, b'{"message": "Malformed JSON."}')
                         return
             try:
@@ -66,11 +70,20 @@ def _make_handler(dispatch: Dispatcher):
                 logger.exception("Unhandled error for %s %s", self.command, parsed.path)
                 self._send(500, b'{"message": "Internal Server Error"}')
                 return
-            self._send(resp.status, resp.json_bytes())
+            self._send(
+                resp.status,
+                resp.json_bytes(),
+                getattr(resp, "content_type", "application/json; charset=UTF-8"),
+            )
 
-        def _send(self, status: int, payload: bytes):
+        def _send(
+            self,
+            status: int,
+            payload: bytes,
+            content_type: str = "application/json; charset=UTF-8",
+        ):
             self.send_response(status)
-            self.send_header("Content-Type", "application/json; charset=UTF-8")
+            self.send_header("Content-Type", content_type)
             self.send_header("Content-Length", str(len(payload)))
             self.end_headers()
             self.wfile.write(payload)

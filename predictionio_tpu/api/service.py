@@ -118,11 +118,15 @@ class EventService:
             return auth
         access_key, channel_id = auth
         resp = self._insert_one(body, access_key, channel_id)
-        if self.stats is not None:
-            name = body.get("event") if isinstance(body, Mapping) else None
-            etype = body.get("entityType") if isinstance(body, Mapping) else None
-            self.stats.update(access_key.appid, resp.status, name, etype)
+        self._record_stats(access_key.appid, body, resp.status)
         return resp
+
+    def _record_stats(self, app_id: int, body: Any, status: int) -> None:
+        if self.stats is None:
+            return
+        name = body.get("event") if isinstance(body, Mapping) else None
+        etype = body.get("entityType") if isinstance(body, Mapping) else None
+        self.stats.update(app_id, status, name, etype)
 
     def _insert_one(self, body: Any, access_key, channel_id) -> Response:
         if not isinstance(body, Mapping):
@@ -156,10 +160,7 @@ class EventService:
             entry = dict(r.body)
             entry["status"] = r.status
             results.append(entry)
-            if self.stats is not None:
-                name = item.get("event") if isinstance(item, Mapping) else None
-                etype = item.get("entityType") if isinstance(item, Mapping) else None
-                self.stats.update(access_key.appid, r.status, name, etype)
+            self._record_stats(access_key.appid, item, r.status)
         return Response(200, results)
 
     def get_event(
@@ -226,11 +227,13 @@ class EventService:
         return filters
 
     def get_stats(self, params: Mapping[str, str], headers=None) -> Response:
-        if self.stats is None:
-            return _msg(404, "Stats are not enabled (run with --stats).")
+        # authenticate first: an unauthenticated caller learns nothing
+        # about server configuration
         auth = self._auth(params, headers)
         if isinstance(auth, Response):
             return auth
+        if self.stats is None:
+            return _msg(404, "Stats are not enabled (run with --stats).")
         return Response(200, self.stats.to_json())
 
     def webhook(

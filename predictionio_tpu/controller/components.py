@@ -187,8 +187,16 @@ class JaxAlgorithm(Algorithm[PD, M, Q, P]):
         return self._kernels[name]
 
     def prepare_model_for_serving(self, model: M) -> M:
-        """Device-put model leaves so first query pays no H2D transfer."""
-        return jax.tree.map(jax.device_put, model)
+        """Device-put array leaves so first query pays no H2D transfer
+        (non-array leaves — id maps, vocab, config — stay on host)."""
+        import numpy as _np
+
+        def place(x):
+            if isinstance(x, (jax.Array, _np.ndarray)):
+                return jax.device_put(x)
+            return x
+
+        return jax.tree.map(place, model)
 
 
 class LocalAlgorithm(Algorithm[PD, M, Q, P]):

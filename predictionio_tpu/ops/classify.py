@@ -1,0 +1,129 @@
+"""Classification kernels: multinomial Naive Bayes + logistic regression.
+
+Replaces Spark MLlib's ``mllib.classification.NaiveBayes`` and
+``LogisticRegressionWithLBFGS`` used by the reference's Classification and
+Text-Classification templates (external template repos; SURVEY.md
+sections 3.9, 8.1). Both are single-jit programs: NB is two segment-sum
+reductions; LR is full-batch gradient descent under ``lax.scan`` (no
+Python-loop dispatch, one compile).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "NaiveBayesModel",
+    "train_naive_bayes",
+    "nb_predict_log_proba",
+    "LogRegModel",
+    "train_logreg",
+    "logreg_predict_proba",
+]
+
+
+class NaiveBayesModel(NamedTuple):
+    """log-prior [C] + log-likelihood [C, F] (parity: MLlib NaiveBayesModel
+    ``pi``/``theta``)."""
+
+    log_prior: jax.Array
+    log_theta: jax.Array
+
+
+@functools.partial(jax.jit, static_argnames=("num_classes",))
+def _nb_fit(x: jax.Array, y: jax.Array, num_classes: int, smoothing: float):
+    one_hot = jax.nn.one_hot(y, num_classes, dtype=x.dtype)  # [N, C]
+    class_counts = one_hot.sum(axis=0)  # [C]
+    # feature mass per class: [C, F] — one MXU GEMM
+    feat = one_hot.T @ x
+    log_prior = jnp.log(class_counts + smoothing) - jnp.log(
+        class_counts.sum() + num_classes * smoothing
+    )
+    log_theta = jnp.log(feat + smoothing) - jnp.log(
+        feat.sum(axis=1, keepdims=True) + smoothing * x.shape[1]
+    )
+    return NaiveBayesModel(log_prior, log_theta)
+
+
+def train_naive_bayes(
+    x: np.ndarray, y: np.ndarray, num_classes: int, smoothing: float = 1.0
+) -> NaiveBayesModel:
+    """Multinomial NB (parity: MLlib ``NaiveBayes.train`` with lambda).
+    ``x`` must be non-negative feature counts/weights."""
+    x = jnp.asarray(x, jnp.float32)
+    if (x < 0).any():
+        raise ValueError("multinomial Naive Bayes requires non-negative features")
+    return _nb_fit(x, jnp.asarray(y, jnp.int32), num_classes, float(smoothing))
+
+
+@jax.jit
+def nb_predict_log_proba(model: NaiveBayesModel, x: jax.Array) -> jax.Array:
+    """[B, F] -> [B, C] unnormalized log-posteriors."""
+    return model.log_prior + x @ model.log_theta.T
+
+
+class LogRegModel(NamedTuple):
+    """weights [F, C] + bias [C]."""
+
+    w: jax.Array
+    b: jax.Array
+
+
+@functools.partial(
+    jax.jit, static_argnames=("num_classes", "iterations")
+)
+def _lr_fit(
+    x: jax.Array,
+    y: jax.Array,
+    num_classes: int,
+    iterations: int,
+    lr: float,
+    reg: float,
+):
+    n, f = x.shape
+    one_hot = jax.nn.one_hot(y, num_classes, dtype=x.dtype)
+
+    def step(carry, _):
+        w, b = carry
+        logits = x @ w + b
+        p = jax.nn.softmax(logits, axis=-1)
+        g = (p - one_hot) / n  # [N, C]
+        gw = x.T @ g + reg * w
+        gb = g.sum(axis=0)
+        return (w - lr * gw, b - lr * gb), None
+
+    w0 = jnp.zeros((f, num_classes), x.dtype)
+    b0 = jnp.zeros((num_classes,), x.dtype)
+    (w, b), _ = jax.lax.scan(step, (w0, b0), None, length=iterations)
+    return LogRegModel(w, b)
+
+
+def train_logreg(
+    x: np.ndarray,
+    y: np.ndarray,
+    num_classes: int,
+    iterations: int = 200,
+    lr: float = 1.0,
+    reg: float = 1e-4,
+) -> LogRegModel:
+    """Softmax regression by full-batch GD under ``lax.scan``
+    (parity surface: MLlib ``LogisticRegressionWithLBFGS``; the optimizer
+    differs, the model/served probabilities match)."""
+    return _lr_fit(
+        jnp.asarray(x, jnp.float32),
+        jnp.asarray(y, jnp.int32),
+        num_classes,
+        int(iterations),
+        float(lr),
+        float(reg),
+    )
+
+
+@jax.jit
+def logreg_predict_proba(model: LogRegModel, x: jax.Array) -> jax.Array:
+    return jax.nn.softmax(x @ model.w + model.b, axis=-1)

@@ -1,0 +1,28 @@
+"""Classification engine template (Naive Bayes / Logistic Regression).
+
+Capability parity with the reference's scala-parallel-classification
+template: read ``$set`` user-attribute events, train a classifier over
+numeric attributes, answer attribute queries with a predicted label.
+"""
+
+from predictionio_tpu.templates.classification.engine import (
+    Accuracy,
+    ClassificationDataSource,
+    DataSourceParams,
+    LRAlgorithm,
+    LRParams,
+    NaiveBayesAlgorithm,
+    NaiveBayesParams,
+    engine_factory,
+)
+
+__all__ = [
+    "Accuracy",
+    "ClassificationDataSource",
+    "DataSourceParams",
+    "LRAlgorithm",
+    "LRParams",
+    "NaiveBayesAlgorithm",
+    "NaiveBayesParams",
+    "engine_factory",
+]

@@ -1,0 +1,243 @@
+"""Classification engine: $set attribute events -> NB / LR -> label queries.
+
+Parity map (reference scala-parallel-classification template):
+
+* ``DataSource.scala`` — reads each user entity's current properties via
+  ``aggregateProperties`` (attributes + label) ->
+  :class:`ClassificationDataSource` over
+  ``PEventStore.aggregate_properties``.
+* ``NaiveBayesAlgorithm.scala`` (MLlib NaiveBayes, ``lambda``) ->
+  :class:`NaiveBayesAlgorithm` over
+  :func:`predictionio_tpu.ops.classify.train_naive_bayes`.
+* the LR variant of the template -> :class:`LRAlgorithm`.
+* Query ``{"attr0": 2, "attr1": 0, "attr2": 0}`` ->
+  ``{"label": "..."}``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Mapping, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from predictionio_tpu.controller import (
+    AverageMetric,
+    DataSource,
+    Engine,
+    FirstServing,
+    IdentityPreparator,
+    JaxAlgorithm,
+    Params,
+    SanityCheck,
+    WorkflowContext,
+)
+from predictionio_tpu.data.aggregator import BiMap
+from predictionio_tpu.data.store import PEventStore
+from predictionio_tpu.ops.classify import (
+    logreg_predict_proba,
+    nb_predict_log_proba,
+    train_logreg,
+    train_naive_bayes,
+)
+
+__all__ = [
+    "DataSourceParams",
+    "TrainingData",
+    "ClassificationDataSource",
+    "NaiveBayesParams",
+    "NaiveBayesAlgorithm",
+    "LRParams",
+    "LRAlgorithm",
+    "PredictedResult",
+    "Accuracy",
+    "engine_factory",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class PredictedResult:
+    label: str
+    confidence: float | None = None
+
+    def to_json(self) -> dict[str, Any]:
+        out: dict[str, Any] = {"label": self.label}
+        if self.confidence is not None:
+            out["confidence"] = self.confidence
+        return out
+
+
+@dataclasses.dataclass(frozen=True)
+class DataSourceParams(Params):
+    app_name: str = ""
+    entity_type: str = "user"
+    attributes: tuple = ("attr0", "attr1", "attr2")
+    label: str = "plan"
+    eval_k: int = 3
+    json_aliases = {"appName": "app_name", "entityType": "entity_type", "evalK": "eval_k"}
+
+
+@dataclasses.dataclass
+class TrainingData(SanityCheck):
+    x: np.ndarray  # [N, F]
+    y: np.ndarray  # [N] int
+    label_index: BiMap
+    attributes: tuple
+
+    def sanity_check(self) -> None:
+        if len(self.x) == 0:
+            raise ValueError("No labeled entities found — check appName/attributes")
+        if len(self.x) != len(self.y):
+            raise ValueError("features/labels misaligned")
+
+
+class ClassificationDataSource(DataSource):
+    params_class = DataSourceParams
+
+    def __init__(self, params: DataSourceParams):
+        super().__init__(params)
+
+    def _read_rows(self, ctx: WorkflowContext) -> list[tuple[tuple, str]]:
+        p = self.params
+        props = PEventStore.aggregate_properties(
+            app_name=p.app_name,
+            entity_type=p.entity_type,
+            required=list(p.attributes) + [p.label],
+        )
+        rows = []
+        for _entity_id, pm in sorted(props.items()):
+            feats = tuple(float(pm.get_as(a, float)) for a in p.attributes)
+            rows.append((feats, str(pm[p.label])))
+        return rows
+
+    @staticmethod
+    def _to_training_data(rows: Sequence[tuple[tuple, str]], attributes: tuple) -> TrainingData:
+        label_index = BiMap.string_index(label for _, label in rows)
+        x = np.asarray([f for f, _ in rows], dtype=np.float32).reshape(
+            len(rows), len(attributes)
+        )
+        y = np.fromiter((label_index[l] for _, l in rows), np.int64, len(rows))
+        return TrainingData(x, y, label_index, attributes)
+
+    def read_training(self, ctx: WorkflowContext) -> TrainingData:
+        return self._to_training_data(self._read_rows(ctx), self.params.attributes)
+
+    def read_eval(self, ctx: WorkflowContext):
+        rows = self._read_rows(ctx)
+        k = max(2, self.params.eval_k)
+        folds = []
+        for fold in range(k):
+            train = [r for i, r in enumerate(rows) if i % k != fold]
+            held = [r for i, r in enumerate(rows) if i % k == fold]
+            td = self._to_training_data(train, self.params.attributes)
+            qa = [
+                (dict(zip(self.params.attributes, feats)), label)
+                for feats, label in held
+            ]
+            folds.append((td, {"fold": fold}, qa))
+        return folds
+
+
+class _ClassifierBase(JaxAlgorithm):
+    """Shared predict plumbing: query dict -> feature vector -> label."""
+
+    def _features(self, model, query: Mapping[str, Any]) -> np.ndarray:
+        attrs = model["attributes"]
+        missing = [a for a in attrs if a not in query]
+        if missing:
+            raise ValueError(f"Query is missing attribute(s) {missing}")
+        return np.asarray([[float(query[a]) for a in attrs]], dtype=np.float32)
+
+
+@dataclasses.dataclass(frozen=True)
+class NaiveBayesParams(Params):
+    lambda_: float = 1.0
+    json_aliases = {"lambda": "lambda_"}
+
+
+class NaiveBayesAlgorithm(_ClassifierBase):
+    params_class = NaiveBayesParams
+
+    def __init__(self, params: NaiveBayesParams):
+        super().__init__(params)
+
+    def train(self, ctx: WorkflowContext, pd: TrainingData):
+        model = train_naive_bayes(
+            pd.x, pd.y, num_classes=len(pd.label_index), smoothing=self.params.lambda_
+        )
+        return {
+            "nb": model,
+            "label_index": pd.label_index,
+            "attributes": tuple(pd.attributes),
+        }
+
+    def predict(self, model, query: Mapping[str, Any]) -> PredictedResult:
+        x = self._features(model, query)
+        logp = np.asarray(nb_predict_log_proba(model["nb"], jnp.asarray(x)))[0]
+        idx = int(np.argmax(logp))
+        # normalized posterior as confidence
+        p = np.exp(logp - logp.max())
+        p /= p.sum()
+        return PredictedResult(
+            label=model["label_index"].inverse(idx), confidence=float(p[idx])
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class LRParams(Params):
+    iterations: int = 200
+    step_size: float = 1.0
+    reg: float = 1e-4
+    json_aliases = {"stepSize": "step_size"}
+
+
+class LRAlgorithm(_ClassifierBase):
+    params_class = LRParams
+
+    def __init__(self, params: LRParams):
+        super().__init__(params)
+
+    def train(self, ctx: WorkflowContext, pd: TrainingData):
+        # standardize features for GD conditioning; bake the transform
+        # into the model so serving applies it identically
+        mean = pd.x.mean(axis=0)
+        std = pd.x.std(axis=0)
+        std[std == 0] = 1.0
+        xs = (pd.x - mean) / std
+        model = train_logreg(
+            xs, pd.y, num_classes=len(pd.label_index),
+            iterations=self.params.iterations, lr=self.params.step_size,
+            reg=self.params.reg,
+        )
+        return {
+            "lr": model,
+            "mean": mean,
+            "std": std,
+            "label_index": pd.label_index,
+            "attributes": tuple(pd.attributes),
+        }
+
+    def predict(self, model, query: Mapping[str, Any]) -> PredictedResult:
+        x = (self._features(model, query) - model["mean"]) / model["std"]
+        proba = np.asarray(logreg_predict_proba(model["lr"], jnp.asarray(x)))[0]
+        idx = int(np.argmax(proba))
+        return PredictedResult(
+            label=model["label_index"].inverse(idx), confidence=float(proba[idx])
+        )
+
+
+class Accuracy(AverageMetric):
+    """Fraction of correct labels (parity: the template's Accuracy metric)."""
+
+    def calculate_unit(self, query, predicted: PredictedResult, actual: str) -> float:
+        return 1.0 if predicted.label == str(actual) else 0.0
+
+
+def engine_factory() -> Engine:
+    return Engine(
+        datasource_class=ClassificationDataSource,
+        preparator_class=IdentityPreparator,
+        algorithms_class_map={"naive": NaiveBayesAlgorithm, "lr": LRAlgorithm},
+        serving_class=FirstServing,
+    )

@@ -1,0 +1,26 @@
+"""E-Commerce Recommendation engine template.
+
+Capability parity with the reference's scala-parallel-ecommercerecommendation
+template: implicit ALS over view/buy events with serving-time business
+rules — seen-item filtering via LEventStore, item availability from
+``$set``/``$unset`` constraint entities, category/whiteList/blackList
+filters, and popularity fallback for unknown users.
+"""
+
+from predictionio_tpu.templates.ecommerce.engine import (
+    ECommAlgorithm,
+    ECommAlgorithmParams,
+    DataSourceParams,
+    ECommerceDataSource,
+    Query,
+    engine_factory,
+)
+
+__all__ = [
+    "ECommAlgorithm",
+    "ECommAlgorithmParams",
+    "DataSourceParams",
+    "ECommerceDataSource",
+    "Query",
+    "engine_factory",
+]

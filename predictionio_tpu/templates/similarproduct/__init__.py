@@ -1,0 +1,25 @@
+"""Similar-Product engine template (implicit ALS item similarity).
+
+Capability parity with the reference's scala-parallel-similarproduct
+template: ``view`` events + ``$set`` item properties -> implicit-ALS item
+factors -> "items similar to these" queries with category / whiteList /
+blackList business rules.
+"""
+
+from predictionio_tpu.templates.similarproduct.engine import (
+    ALSAlgorithm,
+    ALSAlgorithmParams,
+    DataSourceParams,
+    Query,
+    SimilarProductDataSource,
+    engine_factory,
+)
+
+__all__ = [
+    "ALSAlgorithm",
+    "ALSAlgorithmParams",
+    "DataSourceParams",
+    "Query",
+    "SimilarProductDataSource",
+    "engine_factory",
+]

@@ -1,0 +1,219 @@
+"""Similar-Product engine: view events -> implicit ALS -> similar items.
+
+Parity map (reference scala-parallel-similarproduct template):
+
+* ``DataSource.scala`` — ``view`` events (user->item) + ``$set`` item
+  entities carrying ``categories`` -> :class:`SimilarProductDataSource`.
+* ``ALSAlgorithm.scala`` — MLlib implicit ``ALS.trainImplicit``; similar
+  items ranked by cosine similarity against the *sum of the query items'
+  factor vectors*, excluding the query items, with ``categories`` /
+  ``whiteList`` / ``blackList`` filters -> :class:`ALSAlgorithm` over
+  :func:`predictionio_tpu.ops.als.train_als`.
+* Query ``{"items": ["i1"], "num": 4, "categories"?: [...],
+  "whiteList"?: [...], "blackList"?: [...]}`` -> ``{"itemScores": [...]}``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Sequence
+
+import numpy as np
+
+from predictionio_tpu.controller import (
+    DataSource,
+    Engine,
+    FirstServing,
+    IdentityPreparator,
+    JaxAlgorithm,
+    Params,
+    SanityCheck,
+    WorkflowContext,
+)
+from predictionio_tpu.data.aggregator import BiMap
+from predictionio_tpu.data.store import PEventStore
+from predictionio_tpu.ops.als import ALSConfig, train_als
+from predictionio_tpu.templates.recommendation.engine import ItemScore, PredictedResult
+
+__all__ = [
+    "Query",
+    "DataSourceParams",
+    "TrainingData",
+    "SimilarProductDataSource",
+    "ALSAlgorithmParams",
+    "ALSAlgorithm",
+    "engine_factory",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class Query:
+    items: tuple = ()
+    num: int = 4
+    categories: tuple | None = None
+    white_list: tuple | None = None
+    black_list: tuple | None = None
+    json_aliases = {"whiteList": "white_list", "blackList": "black_list"}
+
+
+@dataclasses.dataclass(frozen=True)
+class DataSourceParams(Params):
+    app_name: str = ""
+    view_event: str = "view"
+    item_entity_type: str = "item"
+    json_aliases = {"appName": "app_name", "viewEvent": "view_event"}
+
+
+@dataclasses.dataclass
+class TrainingData(SanityCheck):
+    rows: np.ndarray  # user idx
+    cols: np.ndarray  # item idx
+    vals: np.ndarray  # view counts
+    user_index: BiMap
+    item_index: BiMap
+    categories: dict  # item id -> tuple of category strings
+
+    def sanity_check(self) -> None:
+        if self.rows.size == 0:
+            raise ValueError("No view events found — check appName/viewEvent")
+
+
+class SimilarProductDataSource(DataSource):
+    params_class = DataSourceParams
+
+    def __init__(self, params: DataSourceParams):
+        super().__init__(params)
+
+    def read_training(self, ctx: WorkflowContext) -> TrainingData:
+        p = self.params
+        counts: dict[tuple[str, str], float] = {}
+        for e in PEventStore.find(
+            app_name=p.app_name,
+            event_names=[p.view_event],
+            shard_index=ctx.host_index,
+            num_shards=ctx.num_hosts,
+        ):
+            if e.target_entity_id is None:
+                continue
+            key = (e.entity_id, e.target_entity_id)
+            counts[key] = counts.get(key, 0.0) + 1.0
+        user_index = BiMap.string_index(u for u, _ in counts)
+        # include $set-only items so catalog filters work for unviewed items
+        categories: dict[str, tuple] = {}
+        item_props = PEventStore.aggregate_properties(
+            app_name=p.app_name, entity_type=p.item_entity_type
+        )
+        for item_id, pm in item_props.items():
+            cats = pm.opt("categories", list, [])
+            categories[item_id] = tuple(str(c) for c in cats)
+        item_index = BiMap.string_index(
+            list(i for _, i in counts) + list(categories)
+        )
+        n = len(counts)
+        rows = np.fromiter((user_index[u] for u, _ in counts), np.int64, n)
+        cols = np.fromiter((item_index[i] for _, i in counts), np.int64, n)
+        vals = np.fromiter(counts.values(), np.float32, n)
+        return TrainingData(rows, cols, vals, user_index, item_index, categories)
+
+
+@dataclasses.dataclass(frozen=True)
+class ALSAlgorithmParams(Params):
+    rank: int = 10
+    num_iterations: int = 20
+    lambda_: float = 0.01
+    alpha: float = 1.0
+    seed: int | None = 3
+    json_aliases = {"numIterations": "num_iterations", "lambda": "lambda_"}
+
+
+@dataclasses.dataclass
+class SimilarProductModel:
+    item_factors: Any  # [I, K], L2-normalized rows for cosine scoring
+    item_index: BiMap
+    categories: dict
+
+
+class ALSAlgorithm(JaxAlgorithm):
+    params_class = ALSAlgorithmParams
+    query_class = Query
+
+    def __init__(self, params: ALSAlgorithmParams):
+        super().__init__(params)
+
+    def train(self, ctx: WorkflowContext, pd: TrainingData) -> SimilarProductModel:
+        p = self.params
+        factors = train_als(
+            pd.rows, pd.cols, pd.vals,
+            num_users=len(pd.user_index), num_items=len(pd.item_index),
+            config=ALSConfig(
+                rank=p.rank, iterations=p.num_iterations, reg=p.lambda_,
+                implicit=True, alpha=p.alpha, seed=0 if p.seed is None else p.seed,
+            ),
+            mesh=ctx.mesh,
+        )
+        item = np.asarray(factors.item)
+        norms = np.linalg.norm(item, axis=1, keepdims=True)
+        norms[norms == 0] = 1.0
+        return SimilarProductModel(
+            item_factors=item / norms,
+            item_index=pd.item_index,
+            categories=pd.categories,
+        )
+
+    def predict(self, model: SimilarProductModel, query: Query) -> PredictedResult:
+        idxs = [model.item_index.get(i) for i in query.items]
+        idxs = [i for i in idxs if i is not None]
+        if not idxs:
+            return PredictedResult(())
+        target = model.item_factors[idxs].sum(axis=0)
+        norm = np.linalg.norm(target)
+        if norm == 0:
+            return PredictedResult(())
+        scores = model.item_factors @ (target / norm)  # cosine vs all items
+        allowed = self._allowed_mask(model, query, exclude=set(idxs))
+        scores = np.where(allowed, scores, -np.inf)
+        k = min(int(query.num), int(allowed.sum()))
+        if k <= 0:
+            return PredictedResult(())
+        part = np.argpartition(scores, -k)[-k:]
+        top = part[np.argsort(scores[part])[::-1]]
+        return PredictedResult(
+            tuple(
+                ItemScore(item=model.item_index.inverse(int(i)), score=float(scores[i]))
+                for i in top
+                if np.isfinite(scores[i])
+            )
+        )
+
+    @staticmethod
+    def _allowed_mask(model: SimilarProductModel, query: Query, exclude: set) -> np.ndarray:
+        n = model.item_factors.shape[0]
+        allowed = np.ones(n, dtype=bool)
+        for i in exclude:
+            allowed[i] = False
+        if query.white_list:
+            allowed &= np.zeros(n, dtype=bool) | np.isin(
+                np.arange(n),
+                [model.item_index.get(i, -1) for i in query.white_list],
+            )
+        if query.black_list:
+            for item in query.black_list:
+                idx = model.item_index.get(item)
+                if idx is not None:
+                    allowed[idx] = False
+        if query.categories:
+            wanted = set(query.categories)
+            for idx in np.nonzero(allowed)[0]:
+                cats = model.categories.get(model.item_index.inverse(int(idx)), ())
+                if not wanted.intersection(cats):
+                    allowed[idx] = False
+        return allowed
+
+
+def engine_factory() -> Engine:
+    return Engine(
+        datasource_class=SimilarProductDataSource,
+        preparator_class=IdentityPreparator,
+        algorithms_class_map={"als": ALSAlgorithm},
+        serving_class=FirstServing,
+    )

@@ -1,0 +1,26 @@
+"""Text-Classification engine template (TF-IDF + LR / NB).
+
+Capability parity with the reference's text-classification template:
+``$set`` content events carrying text + category -> hashing TF-IDF ->
+logistic-regression (or NB) classifier -> text queries.
+"""
+
+from predictionio_tpu.templates.textclassification.engine import (
+    DataSourceParams,
+    LRTextAlgorithm,
+    LRTextParams,
+    NBTextAlgorithm,
+    NBTextParams,
+    TextDataSource,
+    engine_factory,
+)
+
+__all__ = [
+    "DataSourceParams",
+    "LRTextAlgorithm",
+    "LRTextParams",
+    "NBTextAlgorithm",
+    "NBTextParams",
+    "TextDataSource",
+    "engine_factory",
+]

@@ -1,0 +1,232 @@
+"""Text-Classification engine: text events -> TF-IDF -> LR/NB -> category.
+
+Parity map (reference text-classification template):
+
+* ``DataSource.scala`` — labeled text observations from ``$set`` events
+  (+ an optional stopwords entity) -> :class:`TextDataSource`.
+* ``Preparator.scala`` (``HashingTF``/``IDF``) -> the preparator here
+  fits :func:`predictionio_tpu.ops.text.fit_tfidf` and vectorizes.
+* ``NBAlgorithm.scala`` / ``LRAlgorithm.scala`` -> :class:`NBTextAlgorithm`
+  / :class:`LRTextAlgorithm`.
+* Query ``{"text": "..."}`` -> ``{"category": "...", "confidence": p}``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Mapping
+
+import jax.numpy as jnp
+import numpy as np
+
+from predictionio_tpu.controller import (
+    DataSource,
+    Engine,
+    FirstServing,
+    JaxAlgorithm,
+    Params,
+    Preparator,
+    SanityCheck,
+    WorkflowContext,
+)
+from predictionio_tpu.data.aggregator import BiMap
+from predictionio_tpu.data.store import PEventStore
+from predictionio_tpu.ops.classify import (
+    logreg_predict_proba,
+    nb_predict_log_proba,
+    train_logreg,
+    train_naive_bayes,
+)
+from predictionio_tpu.ops.text import HashingTfIdf, fit_tfidf
+
+__all__ = [
+    "DataSourceParams",
+    "TextDataSource",
+    "TfIdfPreparator",
+    "PreparatorParams",
+    "NBTextParams",
+    "NBTextAlgorithm",
+    "LRTextParams",
+    "LRTextAlgorithm",
+    "PredictedResult",
+    "engine_factory",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class PredictedResult:
+    category: str
+    confidence: float
+
+    def to_json(self) -> dict[str, Any]:
+        return {"category": self.category, "confidence": self.confidence}
+
+
+@dataclasses.dataclass(frozen=True)
+class DataSourceParams(Params):
+    app_name: str = ""
+    entity_type: str = "content"
+    text_property: str = "text"
+    label_property: str = "category"
+    eval_k: int = 3
+    json_aliases = {
+        "appName": "app_name",
+        "entityType": "entity_type",
+        "evalK": "eval_k",
+    }
+
+
+@dataclasses.dataclass
+class TextTrainingData(SanityCheck):
+    texts: list
+    labels: list
+
+    def sanity_check(self) -> None:
+        if not self.texts:
+            raise ValueError("No labeled text found — check appName/entityType")
+
+
+class TextDataSource(DataSource):
+    params_class = DataSourceParams
+
+    def __init__(self, params: DataSourceParams):
+        super().__init__(params)
+
+    def _read_rows(self, ctx: WorkflowContext) -> TextTrainingData:
+        p = self.params
+        props = PEventStore.aggregate_properties(
+            app_name=p.app_name,
+            entity_type=p.entity_type,
+            required=[p.text_property, p.label_property],
+        )
+        texts, labels = [], []
+        for _eid, pm in sorted(props.items()):
+            texts.append(str(pm[p.text_property]))
+            labels.append(str(pm[p.label_property]))
+        return TextTrainingData(texts, labels)
+
+    def read_training(self, ctx: WorkflowContext) -> TextTrainingData:
+        return self._read_rows(ctx)
+
+    def read_eval(self, ctx: WorkflowContext):
+        td = self._read_rows(ctx)
+        k = max(2, self.params.eval_k)
+        folds = []
+        for fold in range(k):
+            tr_t = [t for i, t in enumerate(td.texts) if i % k != fold]
+            tr_l = [l for i, l in enumerate(td.labels) if i % k != fold]
+            qa = [
+                ({"text": t}, l)
+                for i, (t, l) in enumerate(zip(td.texts, td.labels))
+                if i % k == fold
+            ]
+            folds.append((TextTrainingData(tr_t, tr_l), {"fold": fold}, qa))
+        return folds
+
+
+@dataclasses.dataclass(frozen=True)
+class PreparatorParams(Params):
+    num_features: int = 4096
+    stopwords: tuple = ()
+    json_aliases = {"numFeatures": "num_features"}
+
+
+@dataclasses.dataclass
+class PreparedTextData:
+    x: np.ndarray  # [N, F] tf-idf
+    y: np.ndarray  # [N]
+    label_index: BiMap
+    featurizer: HashingTfIdf
+
+
+class TfIdfPreparator(Preparator):
+    """Fits TF-IDF on the corpus and vectorizes
+    (parity: the template's Preparator with HashingTF/IDF)."""
+
+    params_class = PreparatorParams
+
+    def __init__(self, params: PreparatorParams):
+        super().__init__(params)
+
+    def prepare(self, ctx: WorkflowContext, td: TextTrainingData) -> PreparedTextData:
+        featurizer = fit_tfidf(
+            td.texts,
+            num_features=self.params.num_features,
+            stopwords=self.params.stopwords,
+        )
+        label_index = BiMap.string_index(td.labels)
+        x = featurizer.transform(td.texts)
+        y = np.fromiter((label_index[l] for l in td.labels), np.int64, len(td.labels))
+        return PreparedTextData(x, y, label_index, featurizer)
+
+
+class _TextAlgoBase(JaxAlgorithm):
+    def _query_text(self, query: Mapping[str, Any]) -> str:
+        if not isinstance(query, Mapping) or "text" not in query:
+            raise ValueError('Query must be {"text": "..."}')
+        return str(query["text"])
+
+
+@dataclasses.dataclass(frozen=True)
+class NBTextParams(Params):
+    lambda_: float = 1.0
+    json_aliases = {"lambda": "lambda_"}
+
+
+class NBTextAlgorithm(_TextAlgoBase):
+    params_class = NBTextParams
+
+    def __init__(self, params: NBTextParams):
+        super().__init__(params)
+
+    def train(self, ctx: WorkflowContext, pd: PreparedTextData):
+        nb = train_naive_bayes(
+            pd.x, pd.y, num_classes=len(pd.label_index), smoothing=self.params.lambda_
+        )
+        return {"nb": nb, "label_index": pd.label_index, "featurizer": pd.featurizer}
+
+    def predict(self, model, query) -> PredictedResult:
+        x = model["featurizer"].transform([self._query_text(query)])
+        logp = np.asarray(nb_predict_log_proba(model["nb"], jnp.asarray(x)))[0]
+        p = np.exp(logp - logp.max())
+        p /= p.sum()
+        idx = int(np.argmax(p))
+        return PredictedResult(model["label_index"].inverse(idx), float(p[idx]))
+
+
+@dataclasses.dataclass(frozen=True)
+class LRTextParams(Params):
+    iterations: int = 300
+    step_size: float = 1.0
+    reg: float = 1e-4
+    json_aliases = {"stepSize": "step_size"}
+
+
+class LRTextAlgorithm(_TextAlgoBase):
+    params_class = LRTextParams
+
+    def __init__(self, params: LRTextParams):
+        super().__init__(params)
+
+    def train(self, ctx: WorkflowContext, pd: PreparedTextData):
+        lr = train_logreg(
+            pd.x, pd.y, num_classes=len(pd.label_index),
+            iterations=self.params.iterations, lr=self.params.step_size,
+            reg=self.params.reg,
+        )
+        return {"lr": lr, "label_index": pd.label_index, "featurizer": pd.featurizer}
+
+    def predict(self, model, query) -> PredictedResult:
+        x = model["featurizer"].transform([self._query_text(query)])
+        proba = np.asarray(logreg_predict_proba(model["lr"], jnp.asarray(x)))[0]
+        idx = int(np.argmax(proba))
+        return PredictedResult(model["label_index"].inverse(idx), float(proba[idx]))
+
+
+def engine_factory() -> Engine:
+    return Engine(
+        datasource_class=TextDataSource,
+        preparator_class=TfIdfPreparator,
+        algorithms_class_map={"nb": NBTextAlgorithm, "lr": LRTextAlgorithm},
+        serving_class=FirstServing,
+    )

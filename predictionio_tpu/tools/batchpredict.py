@@ -33,7 +33,10 @@ def run_batch_predict(
                 query = json.loads(line)
             except json.JSONDecodeError as e:
                 raise ValueError(f"{input_path}:{line_no}: malformed JSON: {e}") from e
-            status, payload = service.handle_query(query)
+            try:
+                status, payload = service.handle_query(query)
+            except Exception as e:  # one bad query must not abort the batch
+                status, payload = 500, {"message": str(e)}
             fout.write(
                 json.dumps(
                     {"query": query, "prediction": payload}

@@ -53,6 +53,11 @@ def app_new(
     key = Storage.get_meta_data_access_keys().insert(
         AccessKey(key=access_key, appid=app_id)
     )
+    if key is None:
+        # roll back the half-created app rather than leave it keyless
+        Storage.get_l_events().remove(app_id)
+        apps.delete(app_id)
+        raise StorageError(f"Access key '{access_key}' already exists.")
     app = apps.get(app_id)
     out(f"Created a new app:")
     out(f"      Name: {name}")
@@ -169,6 +174,8 @@ def accesskey_new(
     new_key = Storage.get_meta_data_access_keys().insert(
         AccessKey(key=key, appid=app.id, events=tuple(events))
     )
+    if new_key is None:
+        raise StorageError(f"Access key '{key}' already exists.")
     out(f"Created new access key: {new_key}")
     return new_key
 

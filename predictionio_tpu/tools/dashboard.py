@@ -80,8 +80,6 @@ class DashboardService:
         if method.upper() != "GET":
             return Response(404, {"message": "Not Found"})
         if path == "/":
-            # HTML page: Response carries a plain string; the HTTP wrapper
-            # JSON-encodes bodies, so wrap in a marker the wrapper honors.
             return _HtmlResponse(200, self.index_html())
         if path == "/evaluations.json":
             return Response(200, self.evaluations_json())
@@ -89,7 +87,10 @@ class DashboardService:
 
 
 class _HtmlResponse:
-    """Duck-typed Response whose payload is raw HTML."""
+    """Duck-typed Response whose payload is raw HTML; the HTTP wrapper
+    reads ``content_type`` for the header."""
+
+    content_type = "text/html; charset=UTF-8"
 
     def __init__(self, status: int, html_text: str):
         self.status = status

@@ -16,6 +16,7 @@ import dataclasses
 import datetime as _dt
 import json
 import logging
+import queue
 import threading
 import urllib.request
 import uuid
@@ -99,9 +100,32 @@ class QueryService:
         self.instance = None
         self.start_time = _dt.datetime.now(_dt.timezone.utc)
         self.query_count = 0
+        # one long-lived worker drains feedback posts — per-query threads
+        # would grow unboundedly when the event server is slow
+        self._feedback_queue: "queue.Queue | None" = None
+        if feedback is not None:
+            self._feedback_queue = queue.Queue(maxsize=10_000)
+            threading.Thread(target=self._feedback_worker, daemon=True).start()
         self.reload()
         for p in self.plugins:
             p.start(self)
+
+    def _feedback_worker(self) -> None:
+        assert self._feedback_queue is not None
+        while True:
+            url, event = self._feedback_queue.get()
+            try:
+                req = urllib.request.Request(
+                    url,
+                    data=json.dumps(event, default=str).encode(),
+                    headers={"Content-Type": "application/json"},
+                    method="POST",
+                )
+                urllib.request.urlopen(req, timeout=5).read()
+            except Exception:
+                logger.exception("Feedback POST failed")
+            finally:
+                self._feedback_queue.task_done()
 
     # ---------------------------------------------------------------- load
     def _resolve_instance(self):
@@ -168,6 +192,8 @@ class QueryService:
             pairs = list(self._algo_model_pairs)
         if serving is None:
             return 503, {"message": "No engine loaded"}
+        if body is None:
+            return 400, {"message": "Query body is required (JSON)."}
         try:
             query = self._bind_query(body, pairs)
         except Exception as e:
@@ -209,20 +235,11 @@ class QueryService:
         url = f"{fb.event_server_url.rstrip('/')}/events.json?accessKey={fb.access_key}"
         if fb.channel:
             url += f"&channel={fb.channel}"
-
-        def post():
-            try:
-                req = urllib.request.Request(
-                    url,
-                    data=json.dumps(event, default=str).encode(),
-                    headers={"Content-Type": "application/json"},
-                    method="POST",
-                )
-                urllib.request.urlopen(req, timeout=5).read()
-            except Exception:
-                logger.exception("Feedback POST failed")
-
-        threading.Thread(target=post, daemon=True).start()
+        try:
+            self._feedback_queue.put_nowait((url, event))
+        except queue.Full:
+            # feedback is best-effort telemetry; never stall the query path
+            logger.warning("Feedback queue full; dropping prediction event")
 
     # -------------------------------------------------------------- status
     def status_json(self) -> dict:

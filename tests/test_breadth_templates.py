@@ -1,0 +1,232 @@
+"""End-to-end tests for the Similar-Product, E-Commerce, and
+Text-Classification templates."""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.controller import local_context
+from predictionio_tpu.data.event import DataMap, Event
+from predictionio_tpu.data.storage.base import App
+from predictionio_tpu.workflow import load_engine_variant, run_train
+
+
+def _deploy(Storage, variant_obj, instance):
+    variant = load_engine_variant(variant_obj)
+    eng = variant.build_engine()
+    ep = variant.engine_params(eng)
+    blob = Storage.get_model_data_models().get(instance.id).models
+    return eng.prepare_deploy(local_context(), ep, instance.id, blob)
+
+
+def _query(serving, pairs, query):
+    q = serving.supplement_base(query)
+    preds = [a.predict_base(m, q) for a, m in pairs]
+    return serving.serve_base(q, preds)
+
+
+# ------------------------------------------------------------ similarproduct
+SP_APP = "sp-app"
+SP_VARIANT = {
+    "id": "similarproduct", "version": "1",
+    "engineFactory": "predictionio_tpu.templates.similarproduct:engine_factory",
+    "datasource": {"params": {"appName": SP_APP}},
+    "algorithms": [{"name": "als", "params": {"rank": 8, "numIterations": 10,
+                                               "lambda": 0.01, "alpha": 10.0}}],
+}
+
+
+@pytest.fixture()
+def sp_app(memory_storage_env):
+    """Users view within two item groups; items carry category properties."""
+    Storage = memory_storage_env
+    app_id = Storage.get_meta_data_apps().insert(App(id=0, name=SP_APP))
+    le = Storage.get_l_events()
+    le.init(app_id)
+    rng = np.random.default_rng(0)
+    for i in range(20):
+        le.insert(
+            Event(event="$set", entity_type="item", entity_id=f"i{i}",
+                  properties=DataMap({"categories": ["even" if i % 2 == 0 else "odd"]})),
+            app_id,
+        )
+    for u in range(40):
+        group = u % 2
+        for i in range(20):
+            if i % 2 == group and rng.random() < 0.7:
+                le.insert(
+                    Event(event="view", entity_type="user", entity_id=str(u),
+                          target_entity_type="item", target_entity_id=f"i{i}"),
+                    app_id,
+                )
+    return Storage
+
+
+class TestSimilarProduct:
+    def test_similar_items_share_group(self, sp_app):
+        from predictionio_tpu.templates.similarproduct import Query
+
+        instance = run_train(load_engine_variant(SP_VARIANT), local_context())
+        serving, pairs = _deploy(sp_app, SP_VARIANT, instance)
+        r = _query(serving, pairs, Query(items=("i0",), num=5))
+        items = [s.item for s in r.item_scores]
+        assert "i0" not in items  # query items excluded
+        even = sum(1 for i in items if int(i[1:]) % 2 == 0)
+        assert even >= 4, f"expected even-group items, got {items}"
+
+    def test_category_and_blacklist_filters(self, sp_app):
+        from predictionio_tpu.templates.similarproduct import Query
+
+        instance = run_train(load_engine_variant(SP_VARIANT), local_context())
+        serving, pairs = _deploy(sp_app, SP_VARIANT, instance)
+        r = _query(serving, pairs, Query(items=("i0",), num=5, categories=("odd",)))
+        assert all(int(s.item[1:]) % 2 == 1 for s in r.item_scores)
+        r2 = _query(
+            serving, pairs, Query(items=("i0",), num=5, black_list=("i2", "i4"))
+        )
+        assert not {"i2", "i4"} & {s.item for s in r2.item_scores}
+
+    def test_unknown_items_empty(self, sp_app):
+        from predictionio_tpu.templates.similarproduct import Query
+
+        instance = run_train(load_engine_variant(SP_VARIANT), local_context())
+        serving, pairs = _deploy(sp_app, SP_VARIANT, instance)
+        assert _query(serving, pairs, Query(items=("zzz",))).item_scores == ()
+
+
+# ---------------------------------------------------------------- ecommerce
+EC_APP = "ec-app"
+EC_VARIANT = {
+    "id": "ecommerce", "version": "1",
+    "engineFactory": "predictionio_tpu.templates.ecommerce:engine_factory",
+    "datasource": {"params": {"appName": EC_APP}},
+    "algorithms": [{"name": "ecomm", "params": {"appName": EC_APP, "rank": 8,
+                                                 "numIterations": 10,
+                                                 "lambda": 0.01, "alpha": 10.0}}],
+}
+
+
+@pytest.fixture()
+def ec_app(memory_storage_env):
+    Storage = memory_storage_env
+    app_id = Storage.get_meta_data_apps().insert(App(id=0, name=EC_APP))
+    le = Storage.get_l_events()
+    le.init(app_id)
+    rng = np.random.default_rng(1)
+    for i in range(20):
+        le.insert(
+            Event(event="$set", entity_type="item", entity_id=f"i{i}",
+                  properties=DataMap({"categories": ["even" if i % 2 == 0 else "odd"]})),
+            app_id,
+        )
+    for u in range(40):
+        group = u % 2
+        for i in range(20):
+            if i % 2 == group and rng.random() < 0.6:
+                le.insert(
+                    Event(event="view", entity_type="user", entity_id=str(u),
+                          target_entity_type="item", target_entity_id=f"i{i}"),
+                    app_id,
+                )
+    # user 0 bought i0 — must not be recommended again
+    le.insert(
+        Event(event="buy", entity_type="user", entity_id="0",
+              target_entity_type="item", target_entity_id="i0"),
+        app_id,
+    )
+    return Storage, app_id
+
+
+class TestECommerce:
+    def test_seen_items_excluded(self, ec_app):
+        from predictionio_tpu.templates.ecommerce import Query
+
+        Storage, _ = ec_app
+        instance = run_train(load_engine_variant(EC_VARIANT), local_context())
+        serving, pairs = _deploy(Storage, EC_VARIANT, instance)
+        r = _query(serving, pairs, Query(user="0", num=10))
+        items = {s.item for s in r.item_scores}
+        # everything user 0 viewed or bought is excluded at serving time
+        seen = {
+            e.target_entity_id
+            for e in Storage.get_l_events().find(
+                ec_app[1], entity_type="user", entity_id="0",
+                event_names=["view", "buy"],
+            )
+        }
+        assert not (items & seen)
+        assert len(items) > 0
+
+    def test_unknown_user_gets_popularity_fallback(self, ec_app):
+        from predictionio_tpu.templates.ecommerce import Query
+
+        Storage, _ = ec_app
+        instance = run_train(load_engine_variant(EC_VARIANT), local_context())
+        serving, pairs = _deploy(Storage, EC_VARIANT, instance)
+        r = _query(serving, pairs, Query(user="stranger", num=3))
+        assert len(r.item_scores) == 3
+
+    def test_unavailable_items_constraint(self, ec_app):
+        from predictionio_tpu.templates.ecommerce import Query
+
+        Storage, app_id = ec_app
+        instance = run_train(load_engine_variant(EC_VARIANT), local_context())
+        serving, pairs = _deploy(Storage, EC_VARIANT, instance)
+        before = _query(serving, pairs, Query(user="stranger", num=3))
+        banned = before.item_scores[0].item
+        Storage.get_l_events().insert(
+            Event(event="$set", entity_type="constraint",
+                  entity_id="unavailableItems",
+                  properties=DataMap({"items": [banned]})),
+            app_id,
+        )
+        after = _query(serving, pairs, Query(user="stranger", num=3))
+        assert banned not in {s.item for s in after.item_scores}
+
+
+# ---------------------------------------------------- text classification
+TX_APP = "tx-app"
+TX_VARIANT = {
+    "id": "textclassification", "version": "1",
+    "engineFactory": "predictionio_tpu.templates.textclassification:engine_factory",
+    "datasource": {"params": {"appName": TX_APP}},
+    "preparator": {"params": {"numFeatures": 512}},
+    "algorithms": [{"name": "nb", "params": {"lambda": 1.0}}],
+}
+
+SPORTS = ["the team won the game", "great match and score", "players on the field",
+          "coach called a timeout", "the final score was close", "a goal in overtime"]
+TECH = ["the compiler optimizes code", "new framework for servers", "gpu kernels are fast",
+        "deploy the model to production", "the api returns json", "debugging the program"]
+
+
+@pytest.fixture()
+def tx_app(memory_storage_env):
+    Storage = memory_storage_env
+    app_id = Storage.get_meta_data_apps().insert(App(id=0, name=TX_APP))
+    le = Storage.get_l_events()
+    le.init(app_id)
+    for n, text in enumerate(SPORTS):
+        le.insert(Event(event="$set", entity_type="content", entity_id=f"s{n}",
+                        properties=DataMap({"text": text, "category": "sports"})), app_id)
+    for n, text in enumerate(TECH):
+        le.insert(Event(event="$set", entity_type="content", entity_id=f"t{n}",
+                        properties=DataMap({"text": text, "category": "tech"})), app_id)
+    return Storage
+
+
+class TestTextClassification:
+    def test_nb_classifies(self, tx_app):
+        instance = run_train(load_engine_variant(TX_VARIANT), local_context())
+        serving, pairs = _deploy(tx_app, TX_VARIANT, instance)
+        r = _query(serving, pairs, {"text": "the players scored a goal"})
+        assert r.category == "sports"
+        r2 = _query(serving, pairs, {"text": "compile and deploy the api"})
+        assert r2.category == "tech"
+        assert 0.0 < r2.confidence <= 1.0
+
+    def test_lr_variant(self, tx_app):
+        v = dict(TX_VARIANT)
+        v["algorithms"] = [{"name": "lr", "params": {"iterations": 400}}]
+        instance = run_train(load_engine_variant(v), local_context())
+        serving, pairs = _deploy(tx_app, v, instance)
+        assert _query(serving, pairs, {"text": "the coach and the team"}).category == "sports"

@@ -1,0 +1,110 @@
+"""Classification template end-to-end: $set attribute events -> NB / LR ->
+label queries; eval sweep comparing both algorithms."""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.controller import (
+    EngineParams,
+    EngineParamsGenerator,
+    Evaluation,
+    local_context,
+)
+from predictionio_tpu.data.event import DataMap, Event
+from predictionio_tpu.data.storage.base import App
+from predictionio_tpu.templates.classification import (
+    Accuracy,
+    DataSourceParams,
+    LRParams,
+    NaiveBayesParams,
+    engine_factory,
+)
+from predictionio_tpu.workflow import load_engine_variant, run_evaluation, run_train
+
+APP = "cls-test-app"
+
+VARIANT = {
+    "id": "classification",
+    "version": "1",
+    "engineFactory": "predictionio_tpu.templates.classification:engine_factory",
+    "datasource": {"params": {"appName": APP}},
+    "algorithms": [{"name": "naive", "params": {"lambda": 1.0}}],
+}
+
+
+@pytest.fixture()
+def cls_app(memory_storage_env):
+    """Three separable classes on integer count features: class i has
+    attr_i large."""
+    Storage = memory_storage_env
+    app_id = Storage.get_meta_data_apps().insert(App(id=0, name=APP))
+    le = Storage.get_l_events()
+    le.init(app_id)
+    rng = np.random.default_rng(0)
+    labels = ["basic", "premium", "gold"]
+    for n in range(120):
+        c = n % 3
+        attrs = [int(rng.poisson(1)) for _ in range(3)]
+        attrs[c] += int(rng.poisson(6)) + 2
+        le.insert(
+            Event(
+                event="$set",
+                entity_type="user",
+                entity_id=str(n),
+                properties=DataMap(
+                    {"attr0": attrs[0], "attr1": attrs[1], "attr2": attrs[2],
+                     "plan": labels[c]}
+                ),
+            ),
+            app_id,
+        )
+    return Storage
+
+
+def _deploy_query(Storage, variant_obj, instance, query):
+    eng = engine_factory()
+    variant = load_engine_variant(variant_obj)
+    ep = variant.engine_params(eng)
+    blob = Storage.get_model_data_models().get(instance.id).models
+    serving, pairs = eng.prepare_deploy(local_context(), ep, instance.id, blob)
+    q = serving.supplement_base(query)
+    preds = [a.predict_base(m, q) for a, m in pairs]
+    return serving.serve_base(q, preds)
+
+
+class TestClassificationEndToEnd:
+    def test_naive_bayes_train_and_predict(self, cls_app):
+        instance = run_train(load_engine_variant(VARIANT), local_context())
+        assert instance.status == "COMPLETED"
+        r = _deploy_query(cls_app, VARIANT, instance, {"attr0": 9, "attr1": 0, "attr2": 1})
+        assert r.label == "basic"
+        assert 0.0 < r.confidence <= 1.0
+        r2 = _deploy_query(cls_app, VARIANT, instance, {"attr0": 0, "attr1": 1, "attr2": 8})
+        assert r2.label == "gold"
+
+    def test_lr_variant(self, cls_app):
+        v = dict(VARIANT)
+        v["algorithms"] = [{"name": "lr", "params": {"iterations": 300}}]
+        instance = run_train(load_engine_variant(v), local_context())
+        r = _deploy_query(cls_app, v, instance, {"attr0": 0, "attr1": 9, "attr2": 0})
+        assert r.label == "premium"
+
+    def test_missing_attribute_raises(self, cls_app):
+        instance = run_train(load_engine_variant(VARIANT), local_context())
+        with pytest.raises(ValueError, match="missing attribute"):
+            _deploy_query(cls_app, VARIANT, instance, {"attr0": 1})
+
+    def test_eval_compares_algorithms(self, cls_app):
+        ds = DataSourceParams(app_name=APP, eval_k=3)
+        candidates = [
+            EngineParams(datasource=ds, algorithms=(("naive", NaiveBayesParams()),)),
+            EngineParams(datasource=ds, algorithms=(("lr", LRParams(iterations=300)),)),
+        ]
+        evaluation = Evaluation(engine=engine_factory(), metric=Accuracy())
+        instance, result = run_evaluation(
+            evaluation, EngineParamsGenerator(candidates), local_context()
+        )
+        assert instance.status == "EVALCOMPLETED"
+        # both classifiers should be way above chance (1/3) on separable data
+        for _, scores in result.engine_params_scores:
+            assert scores.score > 0.8
