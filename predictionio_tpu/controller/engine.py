@@ -170,27 +170,43 @@ class Engine:
         sanity_check: bool = False,
         stop_after_read: bool = False,
         stop_after_prepare: bool = False,
+        timings: dict | None = None,
     ) -> list[Any]:
         """Run DASE training; returns one model per algorithm
         (parity: ``object Engine.train``; the ``stop_after_*`` flags mirror
-        ``WorkflowParams.stopAfterRead/Prepare``)."""
+        ``WorkflowParams.stopAfterRead/Prepare``). When ``timings`` is a
+        dict, per-phase wall-clock seconds are recorded into it
+        (read/prepare/train:<name>) — the EngineInstance timing surface of
+        SURVEY.md section 6.1."""
+        import time as _time
+
+        def _timed(label: str, fn):
+            t0 = _time.perf_counter()
+            result = fn()
+            if timings is not None:
+                timings[label] = round(_time.perf_counter() - t0, 3)
+            return result
+
         # Instantiate algorithms first so a bad engine.json fails before the
         # (expensive) data read — mirrors the reference's early reflection.
         algorithms = self._make_algorithms(engine_params)
         datasource = create_doer(self.datasource_class, engine_params.datasource)
-        td = datasource.read_training_base(ctx)
+        td = _timed("read", lambda: datasource.read_training_base(ctx))
         self._sanity(td, sanity_check, "training data")
         if stop_after_read:
             return []
         preparator = create_doer(self.preparator_class, engine_params.preparator)
-        pd = preparator.prepare_base(ctx, td)
+        pd = _timed("prepare", lambda: preparator.prepare_base(ctx, td))
         self._sanity(pd, sanity_check, "prepared data")
         if stop_after_prepare:
             return []
         models = []
-        for name, algo in algorithms:
+        for i, (name, algo) in enumerate(algorithms):
             logger.info("Training algorithm '%s' (%s)", name, type(algo).__name__)
-            models.append(algo.train_base(ctx, pd))
+            key = f"train:{name}"
+            if timings is not None and key in timings:
+                key = f"train:{name}#{i}"  # same algorithm listed twice
+            models.append(_timed(key, lambda a=algo: a.train_base(ctx, pd)))
         return models
 
     # ------------------------------------------------------------------ eval

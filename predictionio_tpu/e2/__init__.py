@@ -1,0 +1,21 @@
+"""e2 — engine/eval helper library.
+
+Parity: the reference's ``e2/`` module (SURVEY.md section 3.5):
+``CategoricalNaiveBayes``, ``MarkovChain``, ``BinaryVectorizer`` small
+learners plus the k-fold ``splitData`` eval helper. Pure functions over
+host data with jit-compiled math where it counts.
+"""
+
+from predictionio_tpu.e2.engine import (
+    BinaryVectorizer,
+    CategoricalNaiveBayes,
+    MarkovChain,
+)
+from predictionio_tpu.e2.evaluation import k_fold_split
+
+__all__ = [
+    "BinaryVectorizer",
+    "CategoricalNaiveBayes",
+    "MarkovChain",
+    "k_fold_split",
+]

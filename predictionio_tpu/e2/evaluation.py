@@ -1,0 +1,23 @@
+"""Eval split helpers (parity: ``e2/.../evaluation/CommonHelperFunctions.scala``
+``splitData``)."""
+
+from __future__ import annotations
+
+from typing import Sequence, TypeVar
+
+__all__ = ["k_fold_split"]
+
+T = TypeVar("T")
+
+
+def k_fold_split(data: Sequence[T], k: int) -> list[tuple[list[T], list[T]]]:
+    """Deterministic k folds: element i goes to fold ``i % k``. Returns
+    ``[(train, test), ...]`` per fold — the reference's round-robin split."""
+    if k < 2:
+        raise ValueError("k must be >= 2")
+    folds: list[tuple[list[T], list[T]]] = []
+    for fold in range(k):
+        train = [x for i, x in enumerate(data) if i % k != fold]
+        test = [x for i, x in enumerate(data) if i % k == fold]
+        folds.append((train, test))
+    return folds

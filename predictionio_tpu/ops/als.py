@@ -66,6 +66,10 @@ class ALSConfig:
     seed: int = 0
     #: pad rank up to a multiple of this for MXU-friendly K (0 = exact rank)
     rank_pad_multiple: int = 0
+    #: orbax step-checkpoint directory ("" = off); training resumes from
+    #: the latest step found there (resume-on-preemption, SURVEY.md 6.4)
+    checkpoint_dir: str = ""
+    checkpoint_interval: int = 5
 
 
 class ALSFactors(NamedTuple):
@@ -312,6 +316,45 @@ def _device_buckets(b: BucketedRatings, mesh: Mesh | None, data_axis: str) -> tu
     return tuple(out)
 
 
+def _allgather_coo(
+    rows: np.ndarray, cols: np.ndarray, vals: np.ndarray
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Multi-host: exchange per-host COO shards so every process holds the
+    identical global rating set (jax requires globally-consistent values
+    for sharded ``device_put``). This one-time DCN gather replaces Spark's
+    shuffle-on-read; all per-iteration exchange stays in GSPMD collectives.
+    Ragged per-host sizes are padded to the max and masked out after."""
+    from jax.experimental import multihost_utils
+
+    n_local = np.array([len(vals)], dtype=np.int64)
+    n_all = np.asarray(multihost_utils.process_allgather(n_local)).ravel()
+    n_max = int(n_all.max())
+
+    def pad(a, dtype):
+        out = np.zeros(n_max, dtype=dtype)
+        out[: len(a)] = a
+        return out
+
+    stacked = np.stack(
+        [pad(rows, np.int64), pad(cols, np.int64)]
+    ).astype(np.int64)
+    gathered_idx = np.asarray(multihost_utils.process_allgather(stacked))
+    gathered_val = np.asarray(
+        multihost_utils.process_allgather(pad(vals, np.float32))
+    )
+    # gathered_idx: [P, 2, n_max]; gathered_val: [P, n_max]
+    out_r, out_c, out_v = [], [], []
+    for p, n in enumerate(n_all):
+        out_r.append(gathered_idx[p, 0, :n])
+        out_c.append(gathered_idx[p, 1, :n])
+        out_v.append(gathered_val[p, :n])
+    return (
+        np.concatenate(out_r),
+        np.concatenate(out_c),
+        np.concatenate(out_v).astype(np.float32),
+    )
+
+
 def train_als(
     rows: np.ndarray,
     cols: np.ndarray,
@@ -324,9 +367,17 @@ def train_als(
 ) -> ALSFactors:
     """Train factor matrices from COO ratings.
 
+    In a multi-process job, ``rows/cols/vals`` may be this host's shard of
+    the ratings (the sharded event-reader layout); they are all-gathered
+    once so bucket construction is globally consistent.
+
     Returns host-strippable ``ALSFactors`` with the sentinel rows removed:
     ``user [num_users, K]``, ``item [num_items, K]``.
     """
+    if jax.process_count() > 1:
+        rows, cols, vals = _allgather_coo(
+            np.asarray(rows), np.asarray(cols), np.asarray(vals)
+        )
     rank = config.rank
     if config.rank_pad_multiple:
         rank = -(-rank // config.rank_pad_multiple) * config.rank_pad_multiple
@@ -354,12 +405,40 @@ def train_als(
     user_buckets = _device_buckets(user_b, mesh, data_axis)
     item_buckets = _device_buckets(item_b, mesh, data_axis)
 
-    for _ in range(config.iterations):
+    manager = None
+    start_step = 0
+    if config.checkpoint_dir:
+        from predictionio_tpu.utils.checkpoint import CheckpointManager
+
+        manager = CheckpointManager(config.checkpoint_dir)
+        latest = manager.latest_step()
+        if latest is not None and latest < config.iterations:
+            state = manager.restore(latest, like={"user": uf, "item": vf})
+            uf, vf = state["user"], state["item"]
+            start_step = latest
+            import logging
+
+            logging.getLogger(__name__).info(
+                "Resumed ALS from checkpoint step %d", latest
+            )
+
+    for step in range(start_step, config.iterations):
         uf, vf = als_sweep(
             uf, vf, user_buckets, item_buckets,
             reg=config.reg, implicit=config.implicit, alpha=config.alpha,
             mesh=mesh, data_axis=data_axis if mesh is not None else None,
         )
+        if manager is not None and (
+            (step + 1) % config.checkpoint_interval == 0
+            or step + 1 == config.iterations
+        ):
+            manager.save(step + 1, {"user": uf, "item": vf})
+            # block: the next sweep donates these buffers, so an async
+            # save must not still be reading them
+            manager.wait()
+    if manager is not None:
+        manager.wait()
+        manager.close()
     return ALSFactors(user=uf[:num_users], item=vf[:num_items])
 
 

@@ -1,0 +1,32 @@
+"""Distribution layer: multi-host init, mesh helpers, sharded input reads.
+
+Parity: the reference distributes via Spark (driver + executors, netty
+shuffle — SURVEY.md sections 3.9, 6.8). Here distribution is jax-native:
+
+* ``jax.distributed.initialize`` + DCN for multi-host control (the Spark
+  driver role collapses into host 0);
+* ``jax.sharding.Mesh`` + GSPMD collectives over ICI inside jit for all
+  data exchange (no user-visible comm API);
+* deterministic per-host file shards for input (replacing HBase region
+  locality).
+"""
+
+from predictionio_tpu.parallel.distributed import (
+    initialize_from_env,
+    is_multihost,
+    process_count,
+    process_index,
+)
+from predictionio_tpu.parallel.reader import (
+    read_event_shards,
+    write_event_shards,
+)
+
+__all__ = [
+    "initialize_from_env",
+    "is_multihost",
+    "process_count",
+    "process_index",
+    "read_event_shards",
+    "write_event_shards",
+]

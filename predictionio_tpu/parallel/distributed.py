@@ -1,0 +1,70 @@
+"""Multi-host initialization (the ``jax.distributed`` + DCN control plane).
+
+Parity: replaces the reference's Spark driver/executor topology
+(``tools/Runner.scala`` spark-submit bridge — SURVEY.md section 4.1).
+A multi-host job runs the SAME ``pio train`` on every host with three env
+vars set; host 0 plays the coordinator (the Spark-driver role):
+
+    PIO_COORDINATOR_ADDRESS=10.0.0.1:8476
+    PIO_NUM_PROCESSES=4
+    PIO_PROCESS_ID=<0..3>
+
+After ``initialize_from_env()``, ``jax.devices()`` spans every chip of
+the slice, a ``mesh_context()`` builds the global mesh, and the sharded
+event reader gives each host its input shard
+(``shard_index=process_index(), num_shards=process_count()``).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+import jax
+
+__all__ = [
+    "initialize_from_env",
+    "is_multihost",
+    "process_count",
+    "process_index",
+]
+
+logger = logging.getLogger(__name__)
+
+_initialized = False
+
+
+def initialize_from_env() -> bool:
+    """Call ``jax.distributed.initialize`` if the ``PIO_COORDINATOR_*`` env
+    triplet is present. Idempotent; returns True when running multi-host."""
+    global _initialized
+    coordinator = os.environ.get("PIO_COORDINATOR_ADDRESS")
+    if not coordinator:
+        return False
+    if _initialized:
+        return True
+    num_processes = int(os.environ.get("PIO_NUM_PROCESSES", "1"))
+    process_id = int(os.environ.get("PIO_PROCESS_ID", "0"))
+    logger.info(
+        "Initializing jax.distributed: coordinator=%s process=%d/%d",
+        coordinator, process_id, num_processes,
+    )
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _initialized = True
+    return True
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def is_multihost() -> bool:
+    return jax.process_count() > 1

@@ -238,16 +238,26 @@ def export_events(
     app_name: str,
     output_path: str,
     channel: str | None = None,
+    num_shards: int = 0,
     out: Out = _print,
 ) -> int:
-    """``pio export`` — event store -> JSON-lines file
+    """``pio export`` — event store -> JSON-lines file, or (with
+    ``num_shards > 0``) a directory of round-robin shard files for
+    multi-host training reads
     (parity: ``tools/export/EventsToFile.scala``)."""
     from predictionio_tpu.data.store import resolve_app
 
     app_id, channel_id = resolve_app(app_name, channel)
+    events = Storage.get_p_events().find(app_id, channel_id)
+    if num_shards > 0:
+        from predictionio_tpu.parallel.reader import write_event_shards
+
+        paths = write_event_shards(events, output_path, num_shards=num_shards)
+        out(f"Exported {len(paths)} shards to {output_path}.")
+        return len(paths)
     n = 0
     with open(output_path, "w") as f:
-        for event in Storage.get_p_events().find(app_id, channel_id):
+        for event in events:
             f.write(json.dumps(event_to_json(event), default=str) + "\n")
             n += 1
     out(f"Exported {n} events to {output_path}.")

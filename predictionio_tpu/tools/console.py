@@ -73,6 +73,14 @@ def build_parser() -> argparse.ArgumentParser:
     exp.add_argument("--appname", required=True)
     exp.add_argument("--output", required=True)
     exp.add_argument("--channel")
+    exp.add_argument(
+        "--sharded",
+        type=int,
+        default=0,
+        metavar="N",
+        help="write N round-robin shard files into OUTPUT (a directory) "
+        "for multi-host training reads",
+    )
 
     # ---- train
     train = sub.add_parser("train", help="run the training workflow")
@@ -121,6 +129,11 @@ def build_parser() -> argparse.ArgumentParser:
     db = sub.add_parser("dashboard", help="start the evaluation dashboard")
     db.add_argument("--ip", default="127.0.0.1")
     db.add_argument("--port", type=int, default=9000)
+
+    # ---- adminserver
+    adm = sub.add_parser("adminserver", help="start the admin REST server")
+    adm.add_argument("--ip", default="127.0.0.1")
+    adm.add_argument("--port", type=int, default=7071)
 
     # ---- batchpredict
     bp = sub.add_parser("batchpredict", help="bulk predictions from a query file")
@@ -188,11 +201,15 @@ def main(argv: list[str] | None = None) -> int:
         elif cmd == "import":
             commands.import_events(args.appname, args.input, args.channel)
         elif cmd == "export":
-            commands.export_events(args.appname, args.output, args.channel)
+            commands.export_events(
+                args.appname, args.output, args.channel, num_shards=args.sharded
+            )
         elif cmd == "train":
+            from predictionio_tpu.parallel import initialize_from_env
             from predictionio_tpu.workflow import load_engine_variant, run_train
             from predictionio_tpu.workflow.core import WorkflowParams
 
+            initialize_from_env()  # multi-host when PIO_COORDINATOR_* set
             variant = load_engine_variant(args.engine_json)
             ctx = _parse_mesh(args.mesh)
             instance = run_train(
@@ -267,6 +284,12 @@ def main(argv: list[str] | None = None) -> int:
 
             print(f"Dashboard is listening on {args.ip}:{args.port}")
             serve(DashboardService().dispatch, args.ip, args.port)
+        elif cmd == "adminserver":
+            from predictionio_tpu.api.http import serve
+            from predictionio_tpu.tools.adminserver import AdminService
+
+            print(f"Admin server is listening on {args.ip}:{args.port}")
+            serve(AdminService().dispatch, args.ip, args.port)
         elif cmd == "batchpredict":
             from predictionio_tpu.tools.batchpredict import run_batch_predict
 

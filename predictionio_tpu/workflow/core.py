@@ -76,6 +76,10 @@ def run_train(
     engine = variant.build_engine()
     engine_params = variant.engine_params(engine)
     instances = Storage.get_meta_data_engine_instances()
+    # Multi-host: every host participates in training (collectives need
+    # all of them) but only host 0 — the coordinator, i.e. the Spark-driver
+    # role — writes metadata and model blobs.
+    is_writer = ctx.host_index == 0
 
     instance = EngineInstance(
         id=uuid.uuid4().hex,
@@ -94,27 +98,37 @@ def run_train(
         ),
         **_params_json(engine_params),
     )
-    instances.insert(instance)
+    if is_writer:
+        instances.insert(instance)
     try:
+        timings: dict = {}
         models = engine.train(
             ctx,
             engine_params,
             sanity_check=not workflow_params.skip_sanity_check,
             stop_after_read=workflow_params.stop_after_read,
             stop_after_prepare=workflow_params.stop_after_prepare,
+            timings=timings,
         )
         if workflow_params.stop_after_read or workflow_params.stop_after_prepare:
             # debugging run — nothing to persist (parity: reference aborts
             # after printing the data); record it as not-completed.
             instance = instance.with_status("STOPPED", end_time=_now())
-            instances.update(instance)
+            if is_writer:
+                instances.update(instance)
             return instance
-        if workflow_params.save_model:
+        if workflow_params.save_model and is_writer:
             blob = engine.models_to_bytes(instance.id, engine_params, models)
             Storage.get_model_data_models().insert(Model(id=instance.id, models=blob))
             logger.info("Saved model blob for instance %s (%d bytes)", instance.id, len(blob))
-        instance = instance.with_status("COMPLETED", end_time=_now())
-        instances.update(instance)
+        instance = dataclasses.replace(
+            instance,
+            status="COMPLETED",
+            end_time=_now(),
+            env={**instance.env, "phase_timings": json.dumps(timings)},
+        )
+        if is_writer:
+            instances.update(instance)
         logger.info(
             "Training completed: instance %s in %.1fs",
             instance.id,
@@ -122,7 +136,8 @@ def run_train(
         )
         return instance
     except Exception:
-        instances.update(instance.with_status("FAILED", end_time=_now()))
+        if is_writer:
+            instances.update(instance.with_status("FAILED", end_time=_now()))
         raise
 
 

@@ -281,4 +281,24 @@ class QueryService:
                 return Response(200, {"message": "Reloaded"})
             except QueryServerError as e:
                 return Response(500, {"message": str(e)})
+        if path == "/profiler/start" and method == "POST":
+            # jax.profiler trace capture (SURVEY.md section 6.1 rebuild
+            # surface); view the dump with TensorBoard/XProf
+            import jax
+
+            log_dir = (body or {}).get("logDir") if isinstance(body, Mapping) else None
+            log_dir = log_dir or "/tmp/pio-profile"
+            try:
+                jax.profiler.start_trace(log_dir)
+            except RuntimeError as e:
+                return Response(409, {"message": str(e)})
+            return Response(200, {"message": "Profiler started", "logDir": log_dir})
+        if path == "/profiler/stop" and method == "POST":
+            import jax
+
+            try:
+                jax.profiler.stop_trace()
+            except RuntimeError as e:
+                return Response(409, {"message": str(e)})
+            return Response(200, {"message": "Profiler stopped"})
         return Response(404, {"message": "Not Found"})

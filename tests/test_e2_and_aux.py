@@ -1,0 +1,179 @@
+"""Tests for the e2 helper library, SelfCleaningDataSource, and the
+admin server."""
+
+import datetime as dt
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.e2 import (
+    BinaryVectorizer,
+    CategoricalNaiveBayes,
+    MarkovChain,
+    k_fold_split,
+)
+
+
+class TestCategoricalNaiveBayes:
+    DATA = [
+        ("spam", ["offer", "yes"]),
+        ("spam", ["offer", "no"]),
+        ("spam", ["win", "yes"]),
+        ("ham", ["meeting", "no"]),
+        ("ham", ["meeting", "yes"]),
+        ("ham", ["report", "no"]),
+    ]
+
+    def test_predicts_dominant_class(self):
+        nb = CategoricalNaiveBayes().fit(self.DATA)
+        assert nb.predict(["offer", "yes"]) == "spam"
+        assert nb.predict(["meeting", "no"]) == "ham"
+
+    def test_unseen_value_uses_smoothing(self):
+        nb = CategoricalNaiveBayes().fit(self.DATA)
+        # unseen first feature: decided by second feature + priors, no crash
+        assert nb.predict(["novel", "no"]) in {"spam", "ham"}
+
+    def test_unsmoothed_cannot_score_unseen(self):
+        nb = CategoricalNaiveBayes(smoothing=0.0).fit(self.DATA)
+        assert nb.log_score("spam", ["novel", "yes"]) is None
+
+
+class TestMarkovChain:
+    def test_transition_probabilities(self):
+        mc = MarkovChain().fit(
+            [("a", "b"), ("a", "b"), ("a", "c"), ("b", "c")]
+        )
+        nxt = dict(mc.next_states("a"))
+        assert nxt["b"] == pytest.approx(2 / 3)
+        assert nxt["c"] == pytest.approx(1 / 3)
+        assert mc.next_states("zzz") == []
+
+    def test_top_k_truncation(self):
+        mc = MarkovChain(top_k=1).fit([("a", "b"), ("a", "b"), ("a", "c")])
+        assert [s for s, _ in mc.next_states("a")] == ["b"]
+
+
+class TestBinaryVectorizer:
+    def test_one_hot(self):
+        rows = [{"color": "red", "size": "L"}, {"color": "blue", "size": "L"}]
+        v = BinaryVectorizer.fit(rows)
+        assert v.num_features == 3
+        x = v.transform({"color": "red", "size": "L"})
+        assert x.sum() == 2.0
+        # unseen values ignored
+        assert v.transform({"color": "green"}).sum() == 0.0
+
+
+class TestKFold:
+    def test_partitions(self):
+        data = list(range(10))
+        folds = k_fold_split(data, 3)
+        assert len(folds) == 3
+        for train, test in folds:
+            assert sorted(train + test) == data
+        all_test = [x for _, test in folds for x in test]
+        assert sorted(all_test) == data
+        with pytest.raises(ValueError):
+            k_fold_split(data, 1)
+
+
+class TestSelfCleaning:
+    def test_compaction_and_ttl(self, memory_storage_env):
+        from predictionio_tpu.controller.cleaning import SelfCleaningDataSource
+        from predictionio_tpu.data.event import DataMap, Event
+        from predictionio_tpu.data.storage import Storage
+        from predictionio_tpu.data.storage.base import App
+
+        app_id = Storage.get_meta_data_apps().insert(App(id=0, name="cleanapp"))
+        le = Storage.get_l_events()
+        le.init(app_id)
+        now = dt.datetime.now(dt.timezone.utc)
+        old = now - dt.timedelta(days=10)
+        # property chain: 3 $set + 1 $unset for one entity
+        for i, props in enumerate([{"a": 1}, {"a": 2, "b": 5}, {"c": 9}]):
+            le.insert(
+                Event(event="$set", entity_type="user", entity_id="u1",
+                      properties=DataMap(props),
+                      event_time=old + dt.timedelta(minutes=i)),
+                app_id,
+            )
+        le.insert(
+            Event(event="$unset", entity_type="user", entity_id="u1",
+                  properties=DataMap({"b": None}),
+                  event_time=old + dt.timedelta(minutes=5)),
+            app_id,
+        )
+        # one stale regular event + one fresh one
+        le.insert(Event(event="view", entity_type="user", entity_id="u1",
+                        target_entity_type="item", target_entity_id="i1",
+                        event_time=old), app_id)
+        le.insert(Event(event="view", entity_type="user", entity_id="u1",
+                        target_entity_type="item", target_entity_id="i2",
+                        event_time=now), app_id)
+
+        class DS(SelfCleaningDataSource):
+            app_name = "cleanapp"
+
+        from predictionio_tpu.data.aggregator import aggregate_properties
+
+        before = aggregate_properties(
+            le.find(app_id, event_names=["$set", "$unset", "$delete"])
+        )["u1"]
+        stats = DS().clean_persisted_data(event_window_seconds=86400, now=now)
+        assert stats["compacted_entities"] == 1
+        events = list(le.find(app_id))
+        sets = [e for e in events if e.event == "$set"]
+        views = [e for e in events if e.event == "view"]
+        # full map in the latest $set; a first_updated-preserving empty
+        # $set may precede it
+        assert {"a": 2, "c": 9} in [s.properties.to_dict() for s in sets]
+        after = aggregate_properties(
+            le.find(app_id, event_names=["$set", "$unset", "$delete"])
+        )["u1"]
+        assert after.to_dict() == before.to_dict() == {"a": 2, "c": 9}
+        assert after.first_updated == before.first_updated
+        assert after.last_updated == before.last_updated
+        assert len(views) == 1 and views[0].target_entity_id == "i2"
+
+    def test_entity_with_empty_map_survives_compaction(self, memory_storage_env):
+        from predictionio_tpu.controller.cleaning import SelfCleaningDataSource
+        from predictionio_tpu.data.aggregator import aggregate_properties
+        from predictionio_tpu.data.event import DataMap, Event
+        from predictionio_tpu.data.storage import Storage
+        from predictionio_tpu.data.storage.base import App
+
+        app_id = Storage.get_meta_data_apps().insert(App(id=0, name="cleanapp2"))
+        le = Storage.get_l_events()
+        le.init(app_id)
+        le.insert(Event(event="$set", entity_type="user", entity_id="u9",
+                        properties=DataMap({"a": 1})), app_id)
+        le.insert(Event(event="$unset", entity_type="user", entity_id="u9",
+                        properties=DataMap({"a": None})), app_id)
+
+        class DS(SelfCleaningDataSource):
+            app_name = "cleanapp2"
+
+        DS().clean_persisted_data()
+        props = aggregate_properties(
+            le.find(app_id, event_names=["$set", "$unset", "$delete"])
+        )
+        # the entity still exists, with an empty property map
+        assert "u9" in props and props["u9"].to_dict() == {}
+
+
+class TestAdminServer:
+    def test_app_crud_over_admin_api(self, memory_storage_env):
+        from predictionio_tpu.tools.adminserver import AdminService
+
+        svc = AdminService()
+        assert svc.dispatch("GET", "/", {}).status == 200
+        r = svc.dispatch("POST", "/cmd/app", {}, {"name": "adminapp"})
+        assert r.status == 201 and r.body["accessKey"]
+        listing = svc.dispatch("GET", "/cmd/app", {})
+        assert [a["name"] for a in listing.body] == ["adminapp"]
+        assert svc.dispatch("POST", "/cmd/app", {}, {"name": "adminapp"}).status == 400
+        assert svc.dispatch("DELETE", "/cmd/app/adminapp/data", {}).status == 200
+        assert svc.dispatch("DELETE", "/cmd/app/adminapp", {}).status == 200
+        assert svc.dispatch("GET", "/cmd/app", {}).body == []
+        assert svc.dispatch("DELETE", "/cmd/app/ghost", {}).status == 400

@@ -1,0 +1,113 @@
+"""Parallel-layer tests: sharded event files, per-host assignment, the
+multi-host env wrapper, and a true multi-process jax.distributed smoke
+run on CPU (what the reference never had — SURVEY.md section 5.3)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from predictionio_tpu.data.event import DataMap, Event
+from predictionio_tpu.parallel import (
+    initialize_from_env,
+    read_event_shards,
+    write_event_shards,
+)
+from predictionio_tpu.parallel.reader import shard_paths
+
+
+def _events(n):
+    return [
+        Event(
+            event="rate",
+            entity_type="user",
+            entity_id=str(i),
+            target_entity_type="item",
+            target_entity_id=str(i % 7),
+            properties=DataMap({"rating": float(i % 5 + 1)}),
+        )
+        for i in range(n)
+    ]
+
+
+class TestEventShards:
+    def test_write_read_round_trip(self, tmp_path):
+        paths = write_event_shards(_events(23), str(tmp_path), num_shards=4)
+        assert len(paths) == 4
+        back = list(read_event_shards(str(tmp_path)))
+        assert len(back) == 23
+        assert {e.entity_id for e in back} == {str(i) for i in range(23)}
+
+    def test_host_assignment_partitions_exactly(self, tmp_path):
+        write_event_shards(_events(40), str(tmp_path), num_shards=8)
+        per_host = [
+            {e.entity_id for e in read_event_shards(str(tmp_path), h, 3)}
+            for h in range(3)
+        ]
+        # disjoint and complete across hosts
+        assert per_host[0] | per_host[1] | per_host[2] == {str(i) for i in range(40)}
+        assert not (per_host[0] & per_host[1])
+        assert not (per_host[1] & per_host[2])
+
+    def test_incomplete_shard_set_detected(self, tmp_path):
+        write_event_shards(_events(10), str(tmp_path), num_shards=4)
+        os.remove(os.path.join(str(tmp_path), "events-00002-of-00004.jsonl"))
+        with pytest.raises(ValueError, match="Incomplete"):
+            shard_paths(str(tmp_path))
+
+    def test_empty_dir_raises(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            shard_paths(str(tmp_path))
+
+
+class TestDistributedEnv:
+    def test_noop_without_env(self, monkeypatch):
+        monkeypatch.delenv("PIO_COORDINATOR_ADDRESS", raising=False)
+        assert initialize_from_env() is False
+
+    def test_two_process_cpu_distributed_smoke(self, tmp_path):
+        """Spawn 2 real processes that initialize jax.distributed over
+        localhost DCN and each run one psum across hosts."""
+        script = tmp_path / "worker.py"
+        script.write_text(
+            """
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, %r)
+from predictionio_tpu.parallel import initialize_from_env, process_count
+assert initialize_from_env() is True
+assert process_count() == 2
+import jax.numpy as jnp
+from jax.experimental import multihost_utils
+total = multihost_utils.process_allgather(jnp.array([jax.process_index()]))
+assert sorted(int(x) for x in total.ravel()) == [0, 1]
+print("WORKER-OK", jax.process_index())
+"""
+            % os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+        port = 18476
+        env0 = dict(
+            os.environ,
+            PIO_COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
+            PIO_NUM_PROCESSES="2",
+            PIO_PROCESS_ID="0",
+        )
+        env1 = dict(env0, PIO_PROCESS_ID="1")
+        p0 = subprocess.Popen(
+            [sys.executable, str(script)], env=env0,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+        p1 = subprocess.Popen(
+            [sys.executable, str(script)], env=env1,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+        out0, _ = p0.communicate(timeout=120)
+        out1, _ = p1.communicate(timeout=120)
+        assert p0.returncode == 0, out0
+        assert p1.returncode == 0, out1
+        assert "WORKER-OK 0" in out0
+        assert "WORKER-OK 1" in out1
