@@ -5,27 +5,39 @@ training kernel behind the reference's Recommendation / Similar-Product /
 E-Commerce templates (reached from ``PAlgorithm.train`` — see SURVEY.md
 sections 3.9, 8.1). Nothing here is a port: MLlib's block-partitioned
 shuffle becomes sharded dense compute + XLA collectives, following the
-ALX recipe (PAPERS.md — "ALX: Large Scale Matrix Factorization on TPUs"):
+ALX recipe (PAPERS.md — "ALX: Large Scale Matrix Factorization on TPUs").
 
-* **Bucketed padding** — each row's ragged rating list is padded into one
-  of a few fixed widths, so every step is a static-shape batched einsum
-  the MXU can tile (no data-dependent shapes under jit).
-* **Batched normal equations** — per row ``A x = b`` with
-  ``A = Qᵀ W Q + λI`` built by ``[B,L,K]×[B,L,K] -> [B,K,K]`` einsums
-  (MXU work) and solved by batched Cholesky.
-* **Mesh sharding** — bucket rows are sharded over the ``data`` axis of
-  the mesh; the opposite-side factor matrix is replicated (it is O(N·K),
-  small next to the ratings), so the only collective is the all-gather
-  GSPMD inserts when scattering solved rows back — riding ICI, replacing
-  MLlib's netty shuffle.
+Memory-bounded solver design (v2):
+
+* **Segmented bucketing** — every row's ragged rating list is split into
+  fixed-width segments (powers of two, 8..512 by default). Rows hotter
+  than the max width span multiple max-width segments ("hot" rows), so
+  no tensor ever scales with the hottest row.
+* **Chunked scans** — each bucket is processed in bounded row-chunks via
+  ``lax.scan``: peak HBM is O(chunk_entries · rank), *independent of
+  bucket size*. This is what lets a 20M-rating sweep fit in one chip's
+  HBM (round-1 materialized whole buckets and OOM'd: VERDICT.md weak #1).
+* **Two solve paths** — rows that fit one segment are solved in-chunk
+  (batched normal equations + Cholesky) and scattered straight into the
+  factor table. Hot rows accumulate partial Gramians ``A += QᵀWQ``,
+  ``b += Qᵀr`` across their segments (scatter-add into ``[H, K, K]``,
+  where H ≤ nnz / max_width by construction) and are solved once at the
+  end of the half-sweep.
+* **Mesh sharding** — bucket rows/segments are sharded over the ``data``
+  axis; the persistent factor tables are sharded over the ``model`` axis
+  (ALX-style — NOT replicated, so catalog size scales with the mesh).
+  Each half-sweep all-gathers the opposite table once (O(N·K), small
+  next to the ratings), computes the implicit Gramian with a psum over
+  ``model``, and scatters solved rows back to their ``model`` shard.
 
 Supports MLlib's two objectives:
 
-* **explicit** — squared error on observed ratings with ALS-WR
-  regularization (λ scaled by each row's rating count, MLlib default).
+* **explicit** — squared error with ALS-WR regularization (λ scaled by
+  each row's rating count, MLlib default).
 * **implicit** (Hu-Koren-Volinsky) — confidence ``c = 1 + α·|r|``,
-  preference ``p = [r > 0]``, with the shared ``YᵀY`` Gramian computed
-  once per half-sweep.
+  preference ``p = [r > 0]``, shared ``YᵀY`` Gramian once per half-sweep,
+  and λ scaled by the row's positive-rating count (MLlib's
+  ``numExplicits`` scaling, so reference ``lambda`` values transfer).
 """
 
 from __future__ import annotations
@@ -50,7 +62,19 @@ __all__ = [
     "top_k_items",
 ]
 
-_DEFAULT_BUCKET_WIDTHS = (8, 32, 128, 512, 2048, 8192, 32768)
+#: Segment widths: dense powers of two so within-bucket padding is < 2×;
+#: rows with more ratings than the max width are split into hot segments.
+_DEFAULT_BUCKET_WIDTHS = (8, 16, 32, 64, 128, 256, 512)
+
+#: Max padded entries (rows × width) processed per scan step. Bounds the
+#: per-chunk gather at chunk_entries·rank·4 bytes (256 MB at rank 64).
+_DEFAULT_CHUNK_ENTRIES = 1 << 20
+
+_PRECISIONS = {
+    "default": jax.lax.Precision.DEFAULT,
+    "high": jax.lax.Precision.HIGH,
+    "highest": jax.lax.Precision.HIGHEST,
+}
 
 
 @dataclasses.dataclass(frozen=True)
@@ -70,6 +94,13 @@ class ALSConfig:
     #: the latest step found there (resume-on-preemption, SURVEY.md 6.4)
     checkpoint_dir: str = ""
     checkpoint_interval: int = 5
+    #: segment widths for bucketing (see build_buckets)
+    bucket_widths: tuple = _DEFAULT_BUCKET_WIDTHS
+    #: max padded entries per scan chunk — the HBM knob
+    chunk_entries: int = _DEFAULT_CHUNK_ENTRIES
+    #: matmul precision for the normal equations: "highest" (full f32,
+    #: MLlib-parity accuracy), "high", or "default" (bf16 passes, fastest)
+    precision: str = "highest"
 
 
 class ALSFactors(NamedTuple):
@@ -81,20 +112,68 @@ class ALSFactors(NamedTuple):
     item: jax.Array  # [num_items(+1), K]
 
 
-class _Bucket(NamedTuple):
-    row_id: Any  # [B] int32 — sentinel = num_rows for padding rows
-    idx: Any  # [B, L] int32 — column indices into the other side's factors
-    val: Any  # [B, L] f32 — ratings (0 where masked)
-    mask: Any  # [B, L] f32 — 1 for real entries
+class _Chunked(NamedTuple):
+    """One bucket in scan layout: ``n_chunks`` steps of ``C`` rows of a
+    fixed segment width ``L`` (all shapes static for XLA)."""
+
+    row_id: Any  # [n_chunks, C] int32 — row index (normal) or hot slot (hot);
+    #              padding rows carry the sentinel (num_rows / num_hot)
+    idx: Any  # [n_chunks, C, L] int32 — column indices into the other side
+    val: Any  # [n_chunks, C, L] f32 — ratings (0 where masked)
+    mask: Any  # [n_chunks, C, L] f32 — 1 for real entries
 
 
 class BucketedRatings(NamedTuple):
-    """One side of the ratings matrix in solver layout: a handful of
-    fixed-width padded buckets (static shapes for XLA)."""
+    """One side of the ratings matrix in solver layout."""
 
-    buckets: tuple  # tuple[_Bucket, ...]
+    normal: tuple  # tuple[_Chunked, ...] — rows fitting one segment
+    hot: tuple  # tuple[_Chunked, ...] — segments of hot rows (row_id = slot)
+    hot_rows: Any  # [num_hot + 1] int32 — slot -> row id; last = sentinel
     num_rows: int
     num_cols: int
+    nnz: int  # real entries
+    padded_nnz: int  # entries incl. padding (MXU work actually done)
+
+
+def _chunk(arrs: list, n: int, c: int, l: int) -> _Chunked:
+    """Reshape flat [B(,L)] bucket arrays into scan layout [n, C(, L)]."""
+    row_id, idx, val, mask = arrs
+    return _Chunked(
+        row_id.reshape(n, c),
+        idx.reshape(n, c, l),
+        val.reshape(n, c, l),
+        mask.reshape(n, c, l),
+    )
+
+
+def _fill_bucket(
+    n_seg: int,
+    n_pad: int,
+    width: int,
+    seg_row: np.ndarray,
+    seg_start: np.ndarray,
+    seg_len: np.ndarray,
+    cols_s: np.ndarray,
+    vals_s: np.ndarray,
+    sentinel: int,
+) -> list:
+    """Vectorized ragged fill of one bucket's [n_pad, width] arrays from
+    sorted COO slices (no per-row Python loop — this runs at full-catalog
+    scale before the first TPU step)."""
+    row_id = np.full(n_pad, sentinel, dtype=np.int32)
+    idx = np.zeros((n_pad, width), dtype=np.int32)
+    val = np.zeros((n_pad, width), dtype=np.float32)
+    mask = np.zeros((n_pad, width), dtype=np.float32)
+    row_id[:n_seg] = seg_row
+    if n_seg:
+        dst_row = np.repeat(np.arange(n_seg), seg_len)
+        lane_end = np.cumsum(seg_len)
+        dst_lane = np.arange(int(lane_end[-1])) - np.repeat(lane_end - seg_len, seg_len)
+        src = np.repeat(seg_start, seg_len) + dst_lane
+        idx[dst_row, dst_lane] = cols_s[src]
+        val[dst_row, dst_lane] = vals_s[src]
+        mask[dst_row, dst_lane] = 1.0
+    return [row_id, idx, val, mask]
 
 
 def build_buckets(
@@ -105,13 +184,18 @@ def build_buckets(
     num_cols: int,
     widths: Sequence[int] = _DEFAULT_BUCKET_WIDTHS,
     row_multiple: int = 8,
+    chunk_entries: int = _DEFAULT_CHUNK_ENTRIES,
 ) -> BucketedRatings:
-    """Host-side: COO ratings -> per-row padded buckets.
+    """Host-side: COO ratings -> chunked, segmented, padded buckets.
 
-    Rows are grouped by rating count into the smallest width that fits;
-    each bucket's row count is padded to ``row_multiple`` (keep it a
-    multiple of the mesh's data-axis size so shards divide evenly).
-    Rows with zero ratings are omitted — their factors stay zero.
+    Rows with at most ``max(widths)`` ratings go to the smallest width
+    that fits (normal path). Hotter rows are split into ``max(widths)``-
+    wide segments (hot path) so no shape depends on the hottest row.
+    Every bucket is laid out as ``[n_chunks, C, L]`` with
+    ``C·L ≤ chunk_entries`` and ``C`` a multiple of ``row_multiple``
+    (keep that a multiple of the mesh's data-axis size so chunk rows
+    shard evenly). Rows with zero ratings are absent — ``train_als``
+    zeroes their factors via the rated-row mask.
     """
     rows = np.asarray(rows, dtype=np.int64)
     cols = np.asarray(cols, dtype=np.int64)
@@ -123,47 +207,90 @@ def build_buckets(
     if cols.size and (cols.min() < 0 or cols.max() >= num_cols):
         raise ValueError("column index out of range")
 
+    usable = sorted({int(w) for w in widths if w >= 1})
+    if not usable:
+        raise ValueError("widths must contain at least one positive width")
+    w_max = usable[-1]
+
     order = np.argsort(rows, kind="stable")
-    rows_s, cols_s, vals_s = rows[order], cols[order], vals[order]
-    uniq, starts, counts = np.unique(rows_s, return_index=True, return_counts=True)
+    cols_s, vals_s = cols[order], vals[order]
+    uniq, starts, counts = np.unique(rows[order], return_index=True, return_counts=True)
 
-    max_count = int(counts.max()) if counts.size else 0
-    usable = [w for w in sorted(widths) if w >= 1]
-    if not usable or max_count > usable[-1]:
-        usable.append(max(max_count, 1))
+    nnz = int(rows.size)
+    padded_nnz = 0
+    normal_chunks: list = []
+    hot_chunks: list = []
 
-    # assign each unique row to the smallest width that fits
-    width_of = np.empty(len(uniq), dtype=np.int64)
-    for w in sorted(usable, reverse=True):
-        width_of[counts <= w] = w
+    def pack(seg_row, seg_start, seg_len, width, sentinel):
+        """Pad segments to chunked layout and append a _Chunked."""
+        nonlocal padded_nnz
+        n_seg = int(seg_row.size)
+        c = max(row_multiple, (chunk_entries // width) // row_multiple * row_multiple)
+        c = min(c, -(-n_seg // row_multiple) * row_multiple)
+        n_chunks = -(-n_seg // c)
+        n_pad = n_chunks * c
+        padded_nnz += n_pad * width
+        arrs = _fill_bucket(
+            n_seg, n_pad, width, seg_row, seg_start, seg_len, cols_s, vals_s, sentinel
+        )
+        return _chunk(arrs, n_chunks, c, width)
 
-    buckets = []
-    for w in sorted(set(usable)):
-        sel = np.nonzero(width_of == w)[0]
+    # --- normal rows: one segment in the smallest width that fits --------
+    is_hot = counts > w_max
+    lo = 0
+    for w in usable:
+        sel = np.nonzero(~is_hot & (counts > lo) & (counts <= w))[0]
+        lo = w
         if sel.size == 0:
             continue
-        n = int(sel.size)
-        n_pad = -(-n // row_multiple) * row_multiple
-        row_id = np.full(n_pad, num_rows, dtype=np.int32)
-        idx = np.zeros((n_pad, w), dtype=np.int32)
-        val = np.zeros((n_pad, w), dtype=np.float32)
-        mask = np.zeros((n_pad, w), dtype=np.float32)
-        row_id[:n] = uniq[sel]
-        # vectorized ragged fill: flat destination (row, lane) pairs for
-        # every rating of the bucket's rows — no per-row Python loop
-        # (this runs at full-catalog scale before the first TPU step)
-        c_sel = counts[sel]
-        dst_row = np.repeat(np.arange(n), c_sel)
-        lane_end = np.cumsum(c_sel)
-        dst_lane = np.arange(int(lane_end[-1]) if n else 0) - np.repeat(
-            lane_end - c_sel, c_sel
+        normal_chunks.append(
+            pack(
+                uniq[sel].astype(np.int32), starts[sel], counts[sel], w, num_rows
+            )
         )
-        src = np.repeat(starts[sel], c_sel) + dst_lane
-        idx[dst_row, dst_lane] = cols_s[src]
-        val[dst_row, dst_lane] = vals_s[src]
-        mask[dst_row, dst_lane] = 1.0
-        buckets.append(_Bucket(row_id, idx, val, mask))
-    return BucketedRatings(tuple(buckets), num_rows, num_cols)
+
+    # --- hot rows: split into w_max-wide segments, Gramian-accumulated ---
+    hot_sel = np.nonzero(is_hot)[0]
+    num_hot = int(hot_sel.size)
+    if num_hot:
+        h_counts = counts[hot_sel]
+        n_segs = -(-h_counts // w_max)  # per hot row
+        slot = np.repeat(np.arange(num_hot, dtype=np.int32), n_segs)
+        # segment k of a row starts at row_start + k*w_max
+        seg_k = np.arange(int(n_segs.sum())) - np.repeat(
+            np.cumsum(n_segs) - n_segs, n_segs
+        )
+        seg_start = np.repeat(starts[hot_sel], n_segs) + seg_k * w_max
+        seg_len = np.minimum(
+            np.repeat(h_counts, n_segs) - seg_k * w_max, w_max
+        ).astype(np.int64)
+        hot_chunks.append(pack(slot, seg_start, seg_len, w_max, num_hot))
+    hot_rows = np.full(num_hot + 1, num_rows, dtype=np.int32)
+    if num_hot:
+        hot_rows[:num_hot] = uniq[hot_sel]
+
+    return BucketedRatings(
+        tuple(normal_chunks),
+        tuple(hot_chunks),
+        hot_rows,
+        num_rows,
+        num_cols,
+        nnz,
+        padded_nnz,
+    )
+
+
+def rated_row_mask(b: BucketedRatings) -> np.ndarray:
+    """Bool [num_rows]: which rows appear in the ratings. Rows outside get
+    zero factors (parity: the reference only emits factors for trained
+    entities — VERDICT round-1 advisor finding on random unrated scores)."""
+    mask = np.zeros(b.num_rows + 1, dtype=bool)
+    for ch in b.normal:
+        mask[np.asarray(ch.row_id).ravel()] = True
+    hr = np.asarray(b.hot_rows)
+    mask[hr] = True
+    mask[b.num_rows] = False
+    return mask[: b.num_rows]
 
 
 # ---------------------------------------------------------------------------
@@ -171,149 +298,250 @@ def build_buckets(
 # ---------------------------------------------------------------------------
 
 
-def _solve_bucket(
-    other_factors: jax.Array,  # [num_cols+1, K] — includes zero sentinel row
-    bucket: _Bucket,
-    reg: float,
-    implicit: bool,
-    alpha: float,
-    yty: jax.Array | None,  # [K, K], implicit only
-    mesh: Mesh | None,
-    data_axis: str | None,  # mesh axis bucket rows are sharded over
-) -> jax.Array:
-    """New factors for one bucket's rows: batched normal equations.
-
-    All heavy ops are [B,L,K]-shaped einsums -> MXU; solve is batched
-    Cholesky on [B,K,K].
-    """
-    K = other_factors.shape[-1]
-    if mesh is not None:
-        # replicated table, row-sharded indices -> row-sharded gather; the
-        # out_sharding makes the GSPMD decision explicit (each device
-        # gathers only its rows' factors — the ALX sharded-gather step).
-        gathered = other_factors.at[bucket.idx].get(
-            out_sharding=NamedSharding(mesh, PartitionSpec(data_axis, None, None))
-        )
-    else:
-        gathered = other_factors[bucket.idx]
-    Q = gathered * bucket.mask[..., None]  # [B, L, K]
-    eye = jnp.eye(K, dtype=other_factors.dtype)
-    # Normal equations are solve-accuracy-sensitive: force full-f32 MXU
-    # passes rather than the TPU's default bf16 matmul precision.
-    hi = jax.lax.Precision.HIGHEST
-    if implicit:
-        conf_minus_1 = alpha * jnp.abs(bucket.val) * bucket.mask  # c - 1
-        pref = (bucket.val > 0).astype(Q.dtype) * bucket.mask
-        A = (
-            yty
-            + jnp.einsum("blk,bl,blj->bkj", Q, conf_minus_1, Q, precision=hi)
-            + reg * eye
-        )
-        b = jnp.einsum("blk,bl->bk", Q, (1.0 + conf_minus_1) * pref, precision=hi)
-    else:
-        n_ratings = bucket.mask.sum(axis=-1)  # [B]
-        A = jnp.einsum("blk,blj->bkj", Q, Q, precision=hi) + (
-            reg * jnp.maximum(n_ratings, 1.0)[:, None, None] * eye
-        )
-        b = jnp.einsum("blk,bl->bk", Q, bucket.val * bucket.mask, precision=hi)
-    # SPD by construction -> Cholesky
+def _cho_solve(A: jax.Array, b: jax.Array) -> jax.Array:
+    """Batched SPD solve: A [.., K, K], b [.., K] -> x [.., K]."""
     L = jax.lax.linalg.cholesky(A)
-    x = jax.lax.linalg.triangular_solve(
-        L, b[..., None], left_side=True, lower=True
-    )
+    x = jax.lax.linalg.triangular_solve(L, b[..., None], left_side=True, lower=True)
     x = jax.lax.linalg.triangular_solve(
         L, x, left_side=True, lower=True, transpose_a=True
     )
-    return x[..., 0]  # [B, K]
+    return x[..., 0]
+
+
+def _gram_chunk(
+    other: jax.Array,  # [num_cols+1, K] — replicated working copy
+    chunk_idx: jax.Array,  # [C, L]
+    chunk_val: jax.Array,  # [C, L]
+    chunk_mask: jax.Array,  # [C, L]
+    implicit: bool,
+    alpha: float,
+    hi: jax.lax.Precision,
+    mesh: Mesh | None,
+    data_axis: str | None,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Partial normal equations for one chunk of segments.
+
+    Returns (A [C,K,K], b [C,K], n [C]) WITHOUT the λ/YᵀY terms, so the
+    same kernel serves both the in-chunk solve (normal rows) and the
+    Gramian accumulation (hot-row segments). All heavy ops are
+    [C,L,K]-shaped einsums -> MXU.
+    """
+    if mesh is not None:
+        # replicated table, segment-sharded indices -> segment-sharded
+        # gather (each device touches only its rows — the ALX gather step)
+        gathered = other.at[chunk_idx].get(
+            out_sharding=NamedSharding(mesh, PartitionSpec(data_axis, None, None))
+        )
+    else:
+        gathered = other[chunk_idx]
+    Q = gathered * chunk_mask[..., None]  # [C, L, K]
+    if implicit:
+        conf_minus_1 = alpha * jnp.abs(chunk_val) * chunk_mask  # c - 1
+        pref = (chunk_val > 0).astype(Q.dtype) * chunk_mask
+        A = jnp.einsum("clk,cl,clj->ckj", Q, conf_minus_1, Q, precision=hi)
+        b = jnp.einsum("clk,cl->ck", Q, (1.0 + conf_minus_1) * pref, precision=hi)
+        n = pref.sum(axis=-1)  # MLlib numExplicits: positive ratings
+    else:
+        A = jnp.einsum("clk,clj->ckj", Q, Q, precision=hi)
+        b = jnp.einsum("clk,cl->ck", Q, chunk_val * chunk_mask, precision=hi)
+        n = chunk_mask.sum(axis=-1)
+    return A, b, n
+
+
+def _finish_solve(
+    A: jax.Array,  # [.., K, K] accumulated Gramian (no reg / yty yet)
+    b: jax.Array,  # [.., K]
+    n: jax.Array,  # [..] per-row rating count
+    reg: float,
+    yty: jax.Array | None,
+) -> jax.Array:
+    """Add ALS-WR regularization (λ·max(n,1)·I — MLlib scales λ by the
+    rating count in both objectives) and the implicit YᵀY, then solve."""
+    K = A.shape[-1]
+    eye = jnp.eye(K, dtype=A.dtype)
+    A = A + (reg * jnp.maximum(n, 1.0))[..., None, None] * eye
+    if yty is not None:
+        A = A + yty
+    return _cho_solve(A, b)
 
 
 def _half_sweep(
-    factors: jax.Array,  # [num_rows+1, K] — side being updated
-    other_factors: jax.Array,  # [num_cols+1, K]
-    buckets: tuple,
+    factors: jax.Array,  # [num_rows+1, K] — side being updated (model-sharded)
+    other_factors: jax.Array,  # [num_cols+1, K] (model-sharded)
+    bucketed: BucketedRatings,
     reg: float,
     implicit: bool,
     alpha: float,
+    hi: jax.lax.Precision,
     mesh: Mesh | None,
     data_axis: str | None,
+    model_axis: str | None,
 ) -> jax.Array:
+    model_sharding = None
+    if mesh is not None:
+        # model_axis=None (axis absent from the mesh): replicated tables —
+        # the pure-data-parallel layout of e.g. `pio train --mesh data=8`
+        spec = PartitionSpec(model_axis, None) if model_axis else PartitionSpec(None, None)
+        model_sharding = NamedSharding(mesh, spec)
+        # One explicit all-gather of the opposite table per half-sweep
+        # (O(N·K) over ICI — small next to the ratings). Gathers below are
+        # then device-local. ALX gathers shard-chunks instead; at
+        # PredictionIO catalog scales the one-shot gather is cheaper.
+        other = jax.lax.with_sharding_constraint(
+            other_factors, NamedSharding(mesh, PartitionSpec(None, None))
+        )
+    else:
+        other = other_factors
+
     yty = None
     if implicit:
-        # Gramian over the *other* side; sentinel row is zero so it is a
-        # no-op term. On a mesh this is a sharded matmul + psum over ICI.
-        yty = jnp.matmul(
-            other_factors.T, other_factors, precision=jax.lax.Precision.HIGHEST
-        )
-    for bucket in buckets:
-        new_rows = _solve_bucket(
-            other_factors, bucket, reg, implicit, alpha, yty, mesh, data_axis
-        )
+        # Gramian over the other side; sentinel row is zero so it is a
+        # no-op term. From the model-sharded table this is a sharded
+        # matmul whose contraction psums over the model axis (ICI).
         if mesh is not None:
-            # scatter sharded rows into the replicated factor table — GSPMD
-            # lowers this to the per-shard update + all-gather over ICI
-            # that replaces MLlib's factor-block shuffle.
-            factors = factors.at[bucket.row_id].set(
-                new_rows, out_sharding=NamedSharding(mesh, PartitionSpec(None, None))
+            yty = jnp.matmul(
+                other_factors.T, other_factors, precision=hi,
+                out_sharding=NamedSharding(mesh, PartitionSpec(None, None)),
             )
         else:
-            factors = factors.at[bucket.row_id].set(new_rows)
-    # padding rows scattered into the sentinel; re-zero it
-    return factors.at[factors.shape[0] - 1].set(0.0)
+            yty = jnp.matmul(other_factors.T, other_factors, precision=hi)
+
+    # --- normal rows: solve in-chunk, scatter into the factor table ------
+    for ch in bucketed.normal:
+
+        def step(fac, xs):
+            row_id, idx, val, mask = xs
+            A, b, n = _gram_chunk(other, idx, val, mask, implicit, alpha, hi, mesh, data_axis)
+            x = _finish_solve(A, b, n, reg, yty)  # [C, K]
+            if model_sharding is not None:
+                # scatter data-sharded solved rows to their model shard —
+                # GSPMD lowers to the ICI exchange replacing MLlib's
+                # factor-block shuffle
+                fac = fac.at[row_id].set(x, out_sharding=model_sharding)
+            else:
+                fac = fac.at[row_id].set(x)
+            return fac, None
+
+        factors, _ = jax.lax.scan(step, factors, tuple(ch))
+
+    # --- hot rows: accumulate Gramians across segments, solve once -------
+    if bucketed.hot:
+        num_slots = int(bucketed.hot_rows.shape[0])  # num_hot + sentinel
+        K = factors.shape[-1]
+        replicated = None if mesh is None else NamedSharding(mesh, PartitionSpec())
+        acc = (
+            jnp.zeros((num_slots, K, K), factors.dtype, device=replicated),
+            jnp.zeros((num_slots, K), factors.dtype, device=replicated),
+            jnp.zeros((num_slots,), factors.dtype, device=replicated),
+        )
+
+        def hot_step(carry, xs):
+            A_acc, b_acc, n_acc = carry
+            slot, idx, val, mask = xs
+            A, b, n = _gram_chunk(other, idx, val, mask, implicit, alpha, hi, mesh, data_axis)
+            # scatter-add partial Gramians: segments of one row combine
+            # here — the hot-row splitting that bounds memory by
+            # nnz/max_width instead of the hottest row's count. The
+            # accumulators are replicated (H is small by construction), so
+            # on a mesh the adds psum across the data axis.
+            if replicated is not None:
+                A_acc = A_acc.at[slot].add(A, out_sharding=replicated)
+                b_acc = b_acc.at[slot].add(b, out_sharding=replicated)
+                n_acc = n_acc.at[slot].add(n, out_sharding=replicated)
+            else:
+                A_acc = A_acc.at[slot].add(A)
+                b_acc = b_acc.at[slot].add(b)
+                n_acc = n_acc.at[slot].add(n)
+            return (A_acc, b_acc, n_acc), None
+
+        # accumulate across ALL hot buckets before the one solve+scatter
+        for ch in bucketed.hot:
+            acc, _ = jax.lax.scan(hot_step, acc, tuple(ch))
+        x_hot = _finish_solve(*acc, reg, yty)  # [num_slots, K]
+        hot_rows = jnp.asarray(bucketed.hot_rows)
+        if model_sharding is not None:
+            factors = factors.at[hot_rows].set(x_hot, out_sharding=model_sharding)
+        else:
+            factors = factors.at[hot_rows].set(x_hot)
+
+    # padding rows scattered into the sentinel; re-zero it (array index:
+    # the scalar-index path rejects/breaks on out_sharding). The sentinel
+    # is row ``num_rows`` — the table may carry extra zero rows beyond it
+    # so its length divides the model axis.
+    sentinel = jnp.reshape(jnp.asarray(bucketed.num_rows, jnp.int32), (1,))
+    zero = jnp.zeros((1, factors.shape[1]), factors.dtype)
+    if model_sharding is not None:
+        return factors.at[sentinel].set(zero, out_sharding=model_sharding)
+    return factors.at[sentinel].set(zero)
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("reg", "implicit", "alpha", "mesh", "data_axis"),
+    static_argnames=(
+        "reg", "implicit", "alpha", "precision", "mesh", "data_axis", "model_axis",
+    ),
     donate_argnums=(0, 1),
 )
 def als_sweep(
     user_factors: jax.Array,
     item_factors: jax.Array,
-    user_buckets: tuple,
-    item_buckets: tuple,
+    user_bucketed: BucketedRatings,
+    item_bucketed: BucketedRatings,
     reg: float,
     implicit: bool,
     alpha: float,
+    precision: str = "highest",
     mesh: Mesh | None = None,
     data_axis: str | None = None,
+    model_axis: str | None = None,
 ) -> tuple[jax.Array, jax.Array]:
     """One full ALS iteration: solve users given items, then items given
     users. Compiled once; buffers donated so factors update in place."""
+    hi = _PRECISIONS[precision]
     user_factors = _half_sweep(
-        user_factors, item_factors, user_buckets, reg, implicit, alpha, mesh, data_axis
+        user_factors, item_factors, user_bucketed,
+        reg, implicit, alpha, hi, mesh, data_axis, model_axis,
     )
     item_factors = _half_sweep(
-        item_factors, user_factors, item_buckets, reg, implicit, alpha, mesh, data_axis
+        item_factors, user_factors, item_bucketed,
+        reg, implicit, alpha, hi, mesh, data_axis, model_axis,
     )
     return user_factors, item_factors
 
 
-def _device_buckets(b: BucketedRatings, mesh: Mesh | None, data_axis: str) -> tuple:
-    """Place bucket arrays on device — rows sharded over the mesh's data
-    axis when a mesh is given (replaces Spark's RDD partitioning)."""
-    out = []
-    for bucket in b.buckets:
+def _device_buckets(
+    b: BucketedRatings, mesh: Mesh | None, data_axis: str = "data"
+) -> BucketedRatings:
+    """Place bucket arrays on device — chunk rows sharded over the mesh's
+    data axis when a mesh is given (replaces Spark's RDD partitioning).
+    ``hot_rows`` stays a host numpy array (its size is static metadata)."""
+
+    def put(ch: _Chunked) -> _Chunked:
         if mesh is not None:
-            row_sharded_1d = NamedSharding(mesh, PartitionSpec(data_axis))
-            row_sharded_2d = NamedSharding(mesh, PartitionSpec(data_axis, None))
-            out.append(
-                _Bucket(
-                    jax.device_put(bucket.row_id, row_sharded_1d),
-                    jax.device_put(bucket.idx, row_sharded_2d),
-                    jax.device_put(bucket.val, row_sharded_2d),
-                    jax.device_put(bucket.mask, row_sharded_2d),
-                )
+            s1 = NamedSharding(mesh, PartitionSpec(None, data_axis))
+            s2 = NamedSharding(mesh, PartitionSpec(None, data_axis, None))
+            return _Chunked(
+                jax.device_put(ch.row_id, s1),
+                jax.device_put(ch.idx, s2),
+                jax.device_put(ch.val, s2),
+                jax.device_put(ch.mask, s2),
             )
-        else:
-            out.append(
-                _Bucket(
-                    jnp.asarray(bucket.row_id),
-                    jnp.asarray(bucket.idx),
-                    jnp.asarray(bucket.val),
-                    jnp.asarray(bucket.mask),
-                )
-            )
-    return tuple(out)
+        return _Chunked(
+            jnp.asarray(ch.row_id),
+            jnp.asarray(ch.idx),
+            jnp.asarray(ch.val),
+            jnp.asarray(ch.mask),
+        )
+
+    return BucketedRatings(
+        tuple(put(ch) for ch in b.normal),
+        tuple(put(ch) for ch in b.hot),
+        np.asarray(b.hot_rows),
+        b.num_rows,
+        b.num_cols,
+        b.nnz,
+        b.padded_nnz,
+    )
 
 
 def _allgather_coo(
@@ -335,13 +563,9 @@ def _allgather_coo(
         out[: len(a)] = a
         return out
 
-    stacked = np.stack(
-        [pad(rows, np.int64), pad(cols, np.int64)]
-    ).astype(np.int64)
+    stacked = np.stack([pad(rows, np.int64), pad(cols, np.int64)]).astype(np.int64)
     gathered_idx = np.asarray(multihost_utils.process_allgather(stacked))
-    gathered_val = np.asarray(
-        multihost_utils.process_allgather(pad(vals, np.float32))
-    )
+    gathered_val = np.asarray(multihost_utils.process_allgather(pad(vals, np.float32)))
     # gathered_idx: [P, 2, n_max]; gathered_val: [P, n_max]
     out_r, out_c, out_v = [], [], []
     for p, n in enumerate(n_all):
@@ -364,6 +588,7 @@ def train_als(
     config: ALSConfig = ALSConfig(),
     mesh: Mesh | None = None,
     data_axis: str = "data",
+    model_axis: str = "model",
 ) -> ALSFactors:
     """Train factor matrices from COO ratings.
 
@@ -374,6 +599,15 @@ def train_als(
     Returns host-strippable ``ALSFactors`` with the sentinel rows removed:
     ``user [num_users, K]``, ``item [num_items, K]``.
     """
+    if config.precision not in _PRECISIONS:
+        raise ValueError(
+            f"ALSConfig.precision must be one of {sorted(_PRECISIONS)}, "
+            f"got {config.precision!r}"
+        )
+    if mesh is not None and model_axis not in mesh.shape:
+        # a data-only mesh (e.g. `pio train --mesh data=8`): fall back to
+        # replicated factor tables
+        model_axis = None
     if jax.process_count() > 1:
         rows, cols, vals = _allgather_coo(
             np.asarray(rows), np.asarray(cols), np.asarray(vals)
@@ -384,26 +618,46 @@ def train_als(
 
     row_multiple = 8
     if mesh is not None:
-        # must be a multiple of the data-axis size so shards divide evenly
+        # chunk rows must divide evenly over the data axis
         row_multiple = int(np.lcm(8, mesh.shape.get(data_axis, 1)))
-    user_b = build_buckets(rows, cols, vals, num_users, num_items, row_multiple=row_multiple)
-    item_b = build_buckets(cols, rows, vals, num_items, num_users, row_multiple=row_multiple)
+    user_b = build_buckets(
+        rows, cols, vals, num_users, num_items,
+        widths=config.bucket_widths, row_multiple=row_multiple,
+        chunk_entries=config.chunk_entries,
+    )
+    item_b = build_buckets(
+        cols, rows, vals, num_items, num_users,
+        widths=config.bucket_widths, row_multiple=row_multiple,
+        chunk_entries=config.chunk_entries,
+    )
 
     key_u, key_i = jax.random.split(jax.random.PRNGKey(config.seed))
     scale = 1.0 / np.sqrt(rank)
+    # Table length: num_rows + 1 sentinel row, padded up so the row axis
+    # divides the model-axis size (extra rows stay zero, never written).
+    model_size = int(mesh.shape.get(model_axis, 1)) if mesh is not None else 1
+    n_u = -(-(num_users + 1) // model_size) * model_size
+    n_i = -(-(num_items + 1) // model_size) * model_size
     # MLlib seeds factors with abs(normal)/sqrt(rank) — keeps implicit ALS
-    # preferences non-negative at iteration 0.
+    # preferences non-negative at iteration 0. Unrated rows are zeroed so
+    # cold entities never outscore trained ones (round-1 advisor fix).
+    u_mask = np.append(rated_row_mask(user_b), False)[:, None]
+    i_mask = np.append(rated_row_mask(item_b), False)[:, None]
+    # draw at the canonical (num_rows+1) shape so the init — and therefore
+    # the trained factors — are identical across mesh shapes, then zero-pad
     uf = jnp.abs(jax.random.normal(key_u, (num_users + 1, rank), jnp.float32)) * scale
     vf = jnp.abs(jax.random.normal(key_i, (num_items + 1, rank), jnp.float32)) * scale
-    uf = uf.at[num_users].set(0.0)
-    vf = vf.at[num_items].set(0.0)
+    uf = jnp.pad(uf * jnp.asarray(u_mask), ((0, n_u - num_users - 1), (0, 0)))
+    vf = jnp.pad(vf * jnp.asarray(i_mask), ((0, n_i - num_items - 1), (0, 0)))
     if mesh is not None:
-        replicated = NamedSharding(mesh, PartitionSpec())
-        uf = jax.device_put(uf, replicated)
-        vf = jax.device_put(vf, replicated)
+        # persistent tables sharded over the model axis (ALX): catalog
+        # memory scales with the mesh instead of being replicated
+        model_sharded = NamedSharding(mesh, PartitionSpec(model_axis, None))
+        uf = jax.device_put(uf, model_sharded)
+        vf = jax.device_put(vf, model_sharded)
 
-    user_buckets = _device_buckets(user_b, mesh, data_axis)
-    item_buckets = _device_buckets(item_b, mesh, data_axis)
+    user_bucketed = _device_buckets(user_b, mesh, data_axis)
+    item_bucketed = _device_buckets(item_b, mesh, data_axis)
 
     manager = None
     start_step = 0
@@ -412,10 +666,11 @@ def train_als(
 
         manager = CheckpointManager(config.checkpoint_dir)
         latest = manager.latest_step()
-        if latest is not None and latest < config.iterations:
+        if latest is not None:
             state = manager.restore(latest, like={"user": uf, "item": vf})
             uf, vf = state["user"], state["item"]
-            start_step = latest
+            # a completed run restores and short-circuits the sweep loop
+            start_step = min(latest, config.iterations)
             import logging
 
             logging.getLogger(__name__).info(
@@ -424,9 +679,12 @@ def train_als(
 
     for step in range(start_step, config.iterations):
         uf, vf = als_sweep(
-            uf, vf, user_buckets, item_buckets,
+            uf, vf, user_bucketed, item_bucketed,
             reg=config.reg, implicit=config.implicit, alpha=config.alpha,
-            mesh=mesh, data_axis=data_axis if mesh is not None else None,
+            precision=config.precision,
+            mesh=mesh,
+            data_axis=data_axis if mesh is not None else None,
+            model_axis=model_axis if mesh is not None else None,
         )
         if manager is not None and (
             (step + 1) % config.checkpoint_interval == 0
@@ -439,6 +697,13 @@ def train_als(
     if manager is not None:
         manager.wait()
         manager.close()
+    if mesh is not None:
+        # replicate before stripping the sentinel row: callers consume the
+        # factors as plain (host) arrays, and slicing a model-sharded table
+        # would otherwise need an ambiguous-sharding gather
+        replicated = NamedSharding(mesh, PartitionSpec())
+        uf = jax.device_put(uf, replicated)
+        vf = jax.device_put(vf, replicated)
     return ALSFactors(user=uf[:num_users], item=vf[:num_items])
 
 

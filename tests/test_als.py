@@ -1,8 +1,9 @@
-"""ALS op tests: bucket construction, numpy cross-check of the normal
-equation solves, convergence on synthetic low-rank data, implicit-ALS
-ranking sanity, and mesh-sharded == single-device equivalence
-(the multi-device run exercises real GSPMD partitioning on the virtual
-8-device CPU platform from conftest)."""
+"""ALS op tests: segmented bucket construction, numpy cross-check of the
+normal equation solves, hot-row splitting (Gramian accumulation), chunked
+scans, convergence on synthetic low-rank data, implicit-ALS ranking sanity,
+and mesh-sharded == single-device equivalence on both a pure-data mesh and
+a (4,2) data x model mesh (exercising real GSPMD partitioning on the
+virtual 8-device CPU platform from conftest)."""
 
 import jax
 import jax.numpy as jnp
@@ -14,10 +15,11 @@ from predictionio_tpu.ops.als import (
     ALSConfig,
     build_buckets,
     predict_scores,
+    rated_row_mask,
     top_k_items,
     train_als,
 )
-from predictionio_tpu.ops.als import _half_sweep  # internal cross-check
+from predictionio_tpu.ops.als import _device_buckets, _half_sweep  # internal
 
 
 def synthetic_ratings(num_users=60, num_items=40, rank=4, density=0.3, seed=0):
@@ -31,38 +33,86 @@ def synthetic_ratings(num_users=60, num_items=40, rank=4, density=0.3, seed=0):
     return rows, cols, vals, full
 
 
+def _entries(b):
+    """All (row, col, val) triples stored in a BucketedRatings (hot slots
+    resolved back to row ids), for coverage checks."""
+    seen = []
+    for ch in b.normal:
+        rid = np.asarray(ch.row_id).reshape(-1)
+        idx = np.asarray(ch.idx).reshape(rid.size, -1)
+        val = np.asarray(ch.val).reshape(rid.size, -1)
+        m = np.asarray(ch.mask).reshape(rid.size, -1).astype(bool)
+        for i in range(rid.size):
+            if rid[i] == b.num_rows:
+                assert not m[i].any()
+                continue
+            for j in np.nonzero(m[i])[0]:
+                seen.append((int(rid[i]), int(idx[i, j]), float(val[i, j])))
+    hot_rows = np.asarray(b.hot_rows)
+    for ch in b.hot:
+        slot = np.asarray(ch.row_id).reshape(-1)
+        idx = np.asarray(ch.idx).reshape(slot.size, -1)
+        val = np.asarray(ch.val).reshape(slot.size, -1)
+        m = np.asarray(ch.mask).reshape(slot.size, -1).astype(bool)
+        n_hot = hot_rows.size - 1
+        for i in range(slot.size):
+            if slot[i] == n_hot:
+                assert not m[i].any()
+                continue
+            for j in np.nonzero(m[i])[0]:
+                seen.append((int(hot_rows[slot[i]]), int(idx[i, j]), float(val[i, j])))
+    return seen
+
+
 class TestBuildBuckets:
     def test_covers_all_entries(self):
         rows, cols, vals, _ = synthetic_ratings()
         b = build_buckets(rows, cols, vals, 60, 40)
-        seen = set()
-        total = 0
-        for bucket in b.buckets:
-            m = bucket.mask.astype(bool)
-            total += int(m.sum())
-            for r_i in range(bucket.row_id.shape[0]):
-                rid = int(bucket.row_id[r_i])
-                if rid == 60:  # padding row
-                    assert not m[r_i].any()
-                    continue
-                for l_i in np.nonzero(m[r_i])[0]:
-                    seen.add((rid, int(bucket.idx[r_i, l_i]), float(bucket.val[r_i, l_i])))
-        assert total == len(rows)
-        assert seen == {(int(r), int(c), float(v)) for r, c, v in zip(rows, cols, vals)}
+        seen = _entries(b)
+        assert len(seen) == len(rows)
+        assert set(seen) == {
+            (int(r), int(c), float(v)) for r, c, v in zip(rows, cols, vals)
+        }
+
+    def test_hot_rows_split_into_segments(self):
+        # widths max out at 8 -> rows with >8 ratings go to the hot path
+        rng = np.random.default_rng(0)
+        rows = np.concatenate([np.zeros(30, np.int64), rng.integers(1, 10, 40)])
+        cols = np.arange(70, dtype=np.int64) % 50
+        vals = rng.uniform(1, 5, 70).astype(np.float32)
+        b = build_buckets(rows, cols, vals, 10, 50, widths=(4, 8))
+        assert b.hot, "row 0 (30 ratings) must be hot"
+        hot_rows = np.asarray(b.hot_rows)
+        assert 0 in hot_rows[:-1]
+        # all entries still covered exactly once
+        seen = _entries(b)
+        assert len(seen) == 70
+        assert set(seen) == {
+            (int(r), int(c), float(v)) for r, c, v in zip(rows, cols, vals)
+        }
+
+    def test_chunking_bounds_entries_per_step(self):
+        rows, cols, vals, _ = synthetic_ratings(num_users=200, num_items=50, density=0.5)
+        b = build_buckets(rows, cols, vals, 200, 50, chunk_entries=128, row_multiple=8)
+        for ch in list(b.normal) + list(b.hot):
+            n, c, l = ch.idx.shape
+            assert c % 8 == 0
+            assert c * l <= max(128, 8 * l)  # min one row_multiple of rows
 
     def test_row_counts_padded_to_multiple(self):
         rows, cols, vals, _ = synthetic_ratings()
         b = build_buckets(rows, cols, vals, 60, 40, row_multiple=8)
-        for bucket in b.buckets:
-            assert bucket.row_id.shape[0] % 8 == 0
+        for ch in list(b.normal) + list(b.hot):
+            assert ch.row_id.shape[1] % 8 == 0
 
     def test_zero_rating_rows_absent(self):
         rows = np.array([0, 0, 2])
         cols = np.array([0, 1, 1])
         vals = np.array([1.0, 2.0, 3.0])
         b = build_buckets(rows, cols, vals, 4, 2)
-        ids = {int(r) for bucket in b.buckets for r in bucket.row_id if r != 4}
+        ids = {r for r, _, _ in _entries(b)}
         assert ids == {0, 2}
+        np.testing.assert_array_equal(rated_row_mask(b), [True, False, True, False])
 
     def test_index_validation(self):
         with pytest.raises(ValueError, match="out of range"):
@@ -73,11 +123,29 @@ class TestBuildBuckets:
         rows, cols, vals, _ = synthetic_ratings()
         for mult in (24, 40):  # lcm(8,6), lcm(8,5)
             b = build_buckets(rows, cols, vals, 60, 40, row_multiple=mult)
-            for bucket in b.buckets:
-                assert bucket.row_id.shape[0] % mult == 0
+            for ch in list(b.normal) + list(b.hot):
+                assert ch.row_id.shape[1] % mult == 0
+
+    def test_padding_accounting(self):
+        rows, cols, vals, _ = synthetic_ratings()
+        b = build_buckets(rows, cols, vals, 60, 40)
+        assert b.nnz == len(rows)
+        assert b.padded_nnz >= b.nnz
 
 
 class TestExplicitSolveVsNumpy:
+    def _direct_expected(self, rows, cols, vals, item_f, num_users, K, reg):
+        expect = np.zeros((num_users, K), np.float64)
+        for u in range(num_users):
+            sel = rows == u
+            if not sel.any():
+                continue
+            Q = item_f[cols[sel]]
+            n = sel.sum()
+            A = Q.T @ Q + reg * max(n, 1) * np.eye(K)
+            expect[u] = np.linalg.solve(A, Q.T @ vals[sel])
+        return expect
+
     def test_half_sweep_matches_direct_solve(self):
         rows, cols, vals, _ = synthetic_ratings(num_users=20, num_items=15)
         K = 4
@@ -87,25 +155,41 @@ class TestExplicitSolveVsNumpy:
         item_f[15] = 0.0
         user_b = build_buckets(rows, cols, vals, 20, 15)
         uf0 = jnp.zeros((21, K), jnp.float32)
-        from predictionio_tpu.ops.als import _device_buckets
-
         got = np.asarray(
-            _half_sweep(uf0, jnp.asarray(item_f), _device_buckets(user_b, None, "data"),
-                        reg, False, 1.0, None, None)
+            _half_sweep(
+                uf0, jnp.asarray(item_f), _device_buckets(user_b, None),
+                reg, False, 1.0, jax.lax.Precision.HIGHEST, None, None, None,
+            )
         )
-        # direct per-user solve
-        for u in range(20):
-            sel = rows == u
-            if not sel.any():
-                assert np.allclose(got[u], 0.0)
-                continue
-            Q = item_f[cols[sel]]
-            n = sel.sum()
-            A = Q.T @ Q + reg * max(n, 1) * np.eye(K)
-            b = Q.T @ vals[sel]
-            expect = np.linalg.solve(A, b)
-            np.testing.assert_allclose(got[u], expect, rtol=2e-4, atol=2e-5)
+        expect = self._direct_expected(rows, cols, vals, item_f, 20, K, reg)
+        np.testing.assert_allclose(got[:20], expect, rtol=2e-4, atol=2e-5)
         assert np.allclose(got[20], 0.0)  # sentinel re-zeroed
+
+    def test_hot_path_matches_direct_solve(self):
+        """Rows forced through segment splitting + Gramian accumulation
+        must produce the same solution as a direct one-shot solve."""
+        rng = np.random.default_rng(2)
+        num_users, num_items, K, reg = 6, 30, 4, 0.1
+        rows = np.repeat(np.arange(num_users), 25)  # every row has 25 ratings
+        cols = rng.integers(0, num_items, rows.size)
+        vals = rng.uniform(1, 5, rows.size).astype(np.float32)
+        item_f = rng.normal(size=(num_items + 1, K)).astype(np.float32)
+        item_f[num_items] = 0.0
+        # widths cap at 8 -> every row is hot (25 ratings -> 4 segments)
+        user_b = build_buckets(
+            rows, cols, vals, num_users, num_items, widths=(8,), chunk_entries=64
+        )
+        assert user_b.hot and not user_b.normal
+        got = np.asarray(
+            _half_sweep(
+                jnp.zeros((num_users + 1, K), jnp.float32),
+                jnp.asarray(item_f),
+                _device_buckets(user_b, None),
+                reg, False, 1.0, jax.lax.Precision.HIGHEST, None, None, None,
+            )
+        )
+        expect = self._direct_expected(rows, cols, vals, item_f, num_users, K, reg)
+        np.testing.assert_allclose(got[:num_users], expect, rtol=2e-4, atol=2e-5)
 
 
 class TestTrainConvergence:
@@ -114,6 +198,17 @@ class TestTrainConvergence:
         factors = train_als(
             rows, cols, vals, 60, 40,
             ALSConfig(rank=6, iterations=12, reg=0.01),
+        )
+        pred = np.asarray(factors.user) @ np.asarray(factors.item).T
+        rmse = np.sqrt(np.mean((pred[rows, cols] - vals) ** 2))
+        assert rmse < 0.15, f"RMSE {rmse} too high"
+
+    def test_explicit_with_hot_splitting_reconstructs(self):
+        rows, cols, vals, _ = synthetic_ratings(density=0.5)
+        factors = train_als(
+            rows, cols, vals, 60, 40,
+            ALSConfig(rank=6, iterations=12, reg=0.01,
+                      bucket_widths=(4, 8), chunk_entries=256),
         )
         pred = np.asarray(factors.user) @ np.asarray(factors.item).T
         rmse = np.sqrt(np.mean((pred[rows, cols] - vals) ** 2))
@@ -148,6 +243,16 @@ class TestTrainConvergence:
         f2 = train_als(rows, cols, vals, 60, 40, cfg)
         np.testing.assert_array_equal(np.asarray(f1.user), np.asarray(f2.user))
 
+    def test_unrated_rows_get_zero_factors(self):
+        # advisor fix: entities with no ratings must not carry random factors
+        rows = np.array([0, 0, 2])
+        cols = np.array([0, 1, 1])
+        vals = np.array([4.0, 3.0, 5.0], np.float32)
+        f = train_als(rows, cols, vals, 4, 3, ALSConfig(rank=4, iterations=2))
+        assert np.allclose(np.asarray(f.user)[[1, 3]], 0.0)
+        assert np.allclose(np.asarray(f.item)[2], 0.0)
+        assert not np.allclose(np.asarray(f.user)[0], 0.0)
+
 
 class TestMeshSharding:
     def test_mesh_matches_single_device(self):
@@ -162,6 +267,50 @@ class TestMeshSharding:
         )
         np.testing.assert_allclose(
             np.asarray(single.item), np.asarray(sharded.item), rtol=1e-4, atol=1e-5
+        )
+
+    def test_data_model_mesh_matches_single_device(self):
+        """(4,2) data x model mesh: factor tables sharded over model, bucket
+        rows over data — the ALX layout with a model axis > 1."""
+        rows, cols, vals, _ = synthetic_ratings()
+        cfg = ALSConfig(rank=4, iterations=4, seed=5)
+        single = train_als(rows, cols, vals, 60, 40, cfg)
+        ctx = mesh_context(axis_sizes=(4, 2))
+        assert ctx.mesh.shape["model"] == 2
+        sharded = train_als(rows, cols, vals, 60, 40, cfg, mesh=ctx.mesh)
+        np.testing.assert_allclose(
+            np.asarray(single.user), np.asarray(sharded.user), rtol=1e-4, atol=1e-5
+        )
+        np.testing.assert_allclose(
+            np.asarray(single.item), np.asarray(sharded.item), rtol=1e-4, atol=1e-5
+        )
+
+    def test_data_only_mesh_falls_back_to_replicated_tables(self):
+        # regression: `pio train --mesh data=8` builds a mesh with no
+        # 'model' axis; train_als must not require one
+        rows, cols, vals, _ = synthetic_ratings()
+        cfg = ALSConfig(rank=4, iterations=2, seed=5)
+        single = train_als(rows, cols, vals, 60, 40, cfg)
+        mesh = jax.make_mesh((8,), ("data",))
+        sharded = train_als(rows, cols, vals, 60, 40, cfg, mesh=mesh)
+        np.testing.assert_allclose(
+            np.asarray(single.user), np.asarray(sharded.user), rtol=1e-4, atol=1e-5
+        )
+
+    def test_invalid_precision_rejected(self):
+        rows, cols, vals, _ = synthetic_ratings()
+        with pytest.raises(ValueError, match="precision"):
+            train_als(rows, cols, vals, 60, 40, ALSConfig(precision="bf16"))
+
+    def test_data_model_mesh_with_hot_rows(self):
+        rows, cols, vals, _ = synthetic_ratings(density=0.6)
+        cfg = ALSConfig(rank=4, iterations=3, seed=5, bucket_widths=(4, 8),
+                        chunk_entries=512, implicit=True, alpha=5.0)
+        single = train_als(rows, cols, vals, 60, 40, cfg)
+        ctx = mesh_context(axis_sizes=(4, 2))
+        sharded = train_als(rows, cols, vals, 60, 40, cfg, mesh=ctx.mesh)
+        np.testing.assert_allclose(
+            np.asarray(single.user), np.asarray(sharded.user), rtol=1e-4, atol=1e-5
         )
 
 
