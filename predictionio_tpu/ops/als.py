@@ -101,6 +101,11 @@ class ALSConfig:
     #: matmul precision for the normal equations: "highest" (full f32,
     #: MLlib-parity accuracy), "high", or "default" (bf16 passes, fastest)
     precision: str = "highest"
+    #: SPD solver for the normal equations: "auto" picks the Pallas
+    #: blocked-Gauss-Jordan kernel on a single-device TPU backend (~3x
+    #: faster than XLA Cholesky at bench shapes) and Cholesky elsewhere;
+    #: explicit "cholesky" / "pallas" / "pallas_interpret" override.
+    solver: str = "auto"
 
 
 class ALSFactors(NamedTuple):
@@ -298,16 +303,6 @@ def rated_row_mask(b: BucketedRatings) -> np.ndarray:
 # ---------------------------------------------------------------------------
 
 
-def _cho_solve(A: jax.Array, b: jax.Array) -> jax.Array:
-    """Batched SPD solve: A [.., K, K], b [.., K] -> x [.., K]."""
-    L = jax.lax.linalg.cholesky(A)
-    x = jax.lax.linalg.triangular_solve(L, b[..., None], left_side=True, lower=True)
-    x = jax.lax.linalg.triangular_solve(
-        L, x, left_side=True, lower=True, transpose_a=True
-    )
-    return x[..., 0]
-
-
 def _gram_chunk(
     other: jax.Array,  # [num_cols+1, K] — replicated working copy
     chunk_idx: jax.Array,  # [C, L]
@@ -354,15 +349,19 @@ def _finish_solve(
     n: jax.Array,  # [..] per-row rating count
     reg: float,
     yty: jax.Array | None,
+    solver: str,
 ) -> jax.Array:
     """Add ALS-WR regularization (λ·max(n,1)·I — MLlib scales λ by the
-    rating count in both objectives) and the implicit YᵀY, then solve."""
+    rating count in both objectives) and the implicit YᵀY, then solve
+    (Pallas blocked-GJ on TPU, Cholesky elsewhere — see ops/solve.py)."""
+    from predictionio_tpu.ops.solve import spd_solve
+
     K = A.shape[-1]
     eye = jnp.eye(K, dtype=A.dtype)
     A = A + (reg * jnp.maximum(n, 1.0))[..., None, None] * eye
     if yty is not None:
         A = A + yty
-    return _cho_solve(A, b)
+    return spd_solve(A, b, method=solver)
 
 
 def _half_sweep(
@@ -373,6 +372,7 @@ def _half_sweep(
     implicit: bool,
     alpha: float,
     hi: jax.lax.Precision,
+    solver: str,
     mesh: Mesh | None,
     data_axis: str | None,
     model_axis: str | None,
@@ -412,7 +412,7 @@ def _half_sweep(
         def step(fac, xs):
             row_id, idx, val, mask = xs
             A, b, n = _gram_chunk(other, idx, val, mask, implicit, alpha, hi, mesh, data_axis)
-            x = _finish_solve(A, b, n, reg, yty)  # [C, K]
+            x = _finish_solve(A, b, n, reg, yty, solver)  # [C, K]
             if model_sharding is not None:
                 # scatter data-sharded solved rows to their model shard —
                 # GSPMD lowers to the ICI exchange replacing MLlib's
@@ -457,7 +457,7 @@ def _half_sweep(
         # accumulate across ALL hot buckets before the one solve+scatter
         for ch in bucketed.hot:
             acc, _ = jax.lax.scan(hot_step, acc, tuple(ch))
-        x_hot = _finish_solve(*acc, reg, yty)  # [num_slots, K]
+        x_hot = _finish_solve(*acc, reg, yty, solver)  # [num_slots, K]
         hot_rows = jnp.asarray(bucketed.hot_rows)
         if model_sharding is not None:
             factors = factors.at[hot_rows].set(x_hot, out_sharding=model_sharding)
@@ -478,7 +478,8 @@ def _half_sweep(
 @functools.partial(
     jax.jit,
     static_argnames=(
-        "reg", "implicit", "alpha", "precision", "mesh", "data_axis", "model_axis",
+        "reg", "implicit", "alpha", "precision", "solver",
+        "mesh", "data_axis", "model_axis",
     ),
     donate_argnums=(0, 1),
 )
@@ -491,6 +492,7 @@ def als_sweep(
     implicit: bool,
     alpha: float,
     precision: str = "highest",
+    solver: str = "cholesky",
     mesh: Mesh | None = None,
     data_axis: str | None = None,
     model_axis: str | None = None,
@@ -500,11 +502,11 @@ def als_sweep(
     hi = _PRECISIONS[precision]
     user_factors = _half_sweep(
         user_factors, item_factors, user_bucketed,
-        reg, implicit, alpha, hi, mesh, data_axis, model_axis,
+        reg, implicit, alpha, hi, solver, mesh, data_axis, model_axis,
     )
     item_factors = _half_sweep(
         item_factors, user_factors, item_bucketed,
-        reg, implicit, alpha, hi, mesh, data_axis, model_axis,
+        reg, implicit, alpha, hi, solver, mesh, data_axis, model_axis,
     )
     return user_factors, item_factors
 
@@ -604,6 +606,17 @@ def train_als(
             f"ALSConfig.precision must be one of {sorted(_PRECISIONS)}, "
             f"got {config.precision!r}"
         )
+    if config.solver not in ("auto", "cholesky", "pallas", "pallas_interpret"):
+        raise ValueError(
+            "ALSConfig.solver must be 'auto', 'cholesky', 'pallas' or "
+            f"'pallas_interpret', got {config.solver!r}"
+        )
+    solver = config.solver
+    if solver == "auto":
+        # the Mosaic kernel is single-device; sharded sweeps keep the
+        # portable Cholesky until the kernel is shard_map-wrapped
+        on_tpu = jax.default_backend() == "tpu"
+        solver = "pallas" if (on_tpu and mesh is None) else "cholesky"
     if mesh is not None and model_axis not in mesh.shape:
         # a data-only mesh (e.g. `pio train --mesh data=8`): fall back to
         # replicated factor tables
@@ -682,6 +695,7 @@ def train_als(
             uf, vf, user_bucketed, item_bucketed,
             reg=config.reg, implicit=config.implicit, alpha=config.alpha,
             precision=config.precision,
+            solver=solver,
             mesh=mesh,
             data_axis=data_axis if mesh is not None else None,
             model_axis=model_axis if mesh is not None else None,

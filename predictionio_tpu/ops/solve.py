@@ -1,0 +1,178 @@
+"""Batched SPD solves — the ALS hot op, as a Pallas TPU kernel.
+
+XLA's ``lax.linalg.cholesky`` lowers a batched [B,K,K] factorization to a
+K-step sequential loop whose every step round-trips the whole batch
+through HBM; at the flagship bench shape ([138k,64,64]) that measures
+~1.1 s/solve on a v5e chip — ~60% of a whole ALS sweep. The kernel here
+keeps each block of rows **resident in VMEM** and runs *blocked*
+Gauss-Jordan elimination vectorized across the batch: pivot blocks of
+P=8 columns are inverted with a tiny unrolled in-VMEM GJ, and the rank-P
+updates run as batched MXU ``dot_general``s at full f32 precision.
+Measured 369 ms vs 1133 ms for the XLA Cholesky at the bench shape
+(~3x), with max rel err ~2e-5 vs LAPACK f64.
+
+Gauss-Jordan without pivoting is numerically safe here: every ALS normal
+matrix is SPD with an ALS-WR ridge (λ·max(n,1)·I), so diagonal pivots
+stay bounded away from zero.
+
+No reference analog — MLlib solves on CPU LAPACK
+(``org.apache.spark.ml.recommendation.ALS`` CholeskySolver); this is the
+TPU-native replacement for that hot path.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["spd_solve", "gj_solve_pallas", "cholesky_solve"]
+
+#: rows per kernel block: [32, K, K] f32 at K=64 is 0.5 MB for A; the
+#: loop-carried working copy, MXU operand copies, and pipelining
+#: double-buffers keep the total under the ~16 MB VMEM budget.
+_BLOCK_ROWS = 32
+
+#: pivot-block width: rank-P updates run on the MXU; P=8 keeps the
+#: in-VMEM pivot-block inversion tiny while giving the MXU real work.
+_PIVOT_BLOCK = 8
+
+_HI = jax.lax.Precision.HIGHEST
+
+
+def cholesky_solve(A: jax.Array, b: jax.Array) -> jax.Array:
+    """Batched SPD solve via XLA's Cholesky: A [.., K, K], b [.., K].
+    The portable path (CPU tests, meshes) — slow on TPU at large batch."""
+    L = jax.lax.linalg.cholesky(A)
+    x = jax.lax.linalg.triangular_solve(L, b[..., None], left_side=True, lower=True)
+    x = jax.lax.linalg.triangular_solve(
+        L, x, left_side=True, lower=True, transpose_a=True
+    )
+    return x[..., 0]
+
+
+def _bdot(a: jax.Array, b: jax.Array) -> jax.Array:
+    """Batched matmul [TB,m,k]@[TB,k,n] at full f32 (bf16 MXU passes lose
+    ~1e-2 per rank-P update — measured 0.35 rel err over a 64-col sweep)."""
+    return jax.lax.dot_general(
+        a, b, (((2,), (1,)), ((0,), (0,))), precision=_HI,
+        preferred_element_type=jnp.float32,
+    )
+
+
+def _gj_kernel(A_ref, b_ref, x_ref, *, pivot_block: int):
+    """Blocked Gauss-Jordan solve of one [TB, K, K] block, fully in VMEM.
+
+    Per pivot block: invert the [TB,P,P] diagonal block with an unrolled
+    masked GJ (VPU), then eliminate its P columns from every row with two
+    batched MXU matmuls. After all K/P blocks A is the identity and b
+    holds the solution. All indices are static (Python-unrolled), so no
+    dynamic-gather lowering is involved.
+    """
+    P = pivot_block
+    A = A_ref[:]  # [TB, K, K]
+    b = b_ref[:]  # [TB, K]
+    K = A.shape[-1]
+    iota = jax.lax.broadcasted_iota(jnp.int32, (1, P), 1)
+    for blk in range(K // P):
+        s = blk * P
+        R = A[:, s : s + P, :]  # pivot rows [TB,P,K]
+        D = R[:, :, s : s + P]  # diagonal block [TB,P,P]
+        rb = b[:, s : s + P]  # [TB,P]
+        # --- invert D: P-step masked GJ carrying the inverse ------------
+        Di = jnp.broadcast_to(jnp.eye(P, dtype=A.dtype), D.shape)
+        M = D
+        for j in range(P):
+            sel = (iota == j).astype(A.dtype)  # [1,P] one-hot pivot
+            prow = jnp.sum(M * sel[:, :, None], 1)  # [TB,P]
+            irow = jnp.sum(Di * sel[:, :, None], 1)
+            d = jnp.sum(prow * sel, 1)  # [TB]
+            inv = 1.0 / d
+            prow_s = prow * inv[:, None]
+            irow_s = irow * inv[:, None]
+            colj = jnp.sum(M * sel[:, None, :], 2)  # [TB,P]
+            f = colj * (1.0 - sel)
+            M = M - f[:, :, None] * prow_s[:, None, :]
+            Di = Di - f[:, :, None] * irow_s[:, None, :]
+            M = M * (1.0 - sel[:, :, None]) + sel[:, :, None] * prow_s[:, None, :]
+            Di = Di * (1.0 - sel[:, :, None]) + sel[:, :, None] * irow_s[:, None, :]
+        # --- rank-P elimination of the pivot columns from all rows ------
+        C = A[:, :, s : s + P]  # [TB,K,P]
+        F = _bdot(C, Di)
+        # pivot rows need G = I - Di so they land on Di @ R (row-reduced
+        # form); all other rows use F
+        parts = []
+        if s:
+            parts.append(F[:, :s])
+        parts.append(F[:, s : s + P] - Di)
+        if s + P < K:
+            parts.append(F[:, s + P :])
+        G = jnp.concatenate(parts, axis=1) if len(parts) > 1 else parts[0]
+        A = A - _bdot(G, R)
+        b = b - _bdot(G, rb[..., None])[..., 0]
+    x_ref[:] = b  # A reduced to I: b holds the solution
+
+
+@functools.partial(
+    jax.jit, static_argnames=("block_rows", "pivot_block", "interpret")
+)
+def gj_solve_pallas(
+    A: jax.Array,  # [B, K, K]
+    b: jax.Array,  # [B, K]
+    block_rows: int = _BLOCK_ROWS,
+    pivot_block: int = _PIVOT_BLOCK,
+    interpret: bool = False,
+) -> jax.Array:
+    """Batched SPD solve, blocked Gauss-Jordan in VMEM. B is padded to a
+    multiple of ``block_rows`` with identity systems (padding solves to
+    0); K must be a multiple of ``pivot_block``."""
+    B, K = b.shape
+    if K % pivot_block:
+        raise ValueError(f"K={K} must be a multiple of pivot_block={pivot_block}")
+    n_pad = -(-B // block_rows) * block_rows - B
+    if n_pad:
+        eye = jnp.broadcast_to(jnp.eye(K, dtype=A.dtype), (n_pad, K, K))
+        A = jnp.concatenate([A, eye], axis=0)
+        b = jnp.concatenate([b, jnp.zeros((n_pad, K), b.dtype)], axis=0)
+    out = pl.pallas_call(
+        functools.partial(_gj_kernel, pivot_block=pivot_block),
+        grid=(A.shape[0] // block_rows,),
+        in_specs=[
+            pl.BlockSpec((block_rows, K, K), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_rows, K), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((block_rows, K), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((A.shape[0], K), b.dtype),
+        interpret=interpret,
+    )(A, b)
+    return out[:B]
+
+
+def spd_solve(A: jax.Array, b: jax.Array, method: str = "cholesky") -> jax.Array:
+    """Dispatch: ``method`` in {"cholesky", "pallas", "pallas_interpret"}.
+
+    Callers pick "pallas" on a real TPU backend (Mosaic-lowered);
+    "pallas_interpret" runs the same kernel logic on CPU for tests;
+    "cholesky" is the portable XLA path. K not divisible by the pivot
+    block falls back to Cholesky (rank is usually a multiple of 8 —
+    ``ALSConfig.rank_pad_multiple`` exists to make it one).
+    """
+    if method in ("pallas", "pallas_interpret"):
+        K = A.shape[-1]
+        if K % _PIVOT_BLOCK == 0:
+            A2 = A.reshape((-1, K, K))
+            b2 = b.reshape((-1, K))
+            x = gj_solve_pallas(A2, b2, interpret=(method == "pallas_interpret"))
+            return x.reshape(b.shape)
+        method = "cholesky"
+    if method == "cholesky":
+        return cholesky_solve(A, b)
+    raise ValueError(
+        f"spd_solve method must be 'cholesky', 'pallas' or 'pallas_interpret', got {method!r}"
+    )

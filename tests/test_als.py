@@ -158,7 +158,8 @@ class TestExplicitSolveVsNumpy:
         got = np.asarray(
             _half_sweep(
                 uf0, jnp.asarray(item_f), _device_buckets(user_b, None),
-                reg, False, 1.0, jax.lax.Precision.HIGHEST, None, None, None,
+                reg, False, 1.0, jax.lax.Precision.HIGHEST, "cholesky",
+                None, None, None,
             )
         )
         expect = self._direct_expected(rows, cols, vals, item_f, 20, K, reg)
@@ -185,7 +186,8 @@ class TestExplicitSolveVsNumpy:
                 jnp.zeros((num_users + 1, K), jnp.float32),
                 jnp.asarray(item_f),
                 _device_buckets(user_b, None),
-                reg, False, 1.0, jax.lax.Precision.HIGHEST, None, None, None,
+                reg, False, 1.0, jax.lax.Precision.HIGHEST, "cholesky",
+                None, None, None,
             )
         )
         expect = self._direct_expected(rows, cols, vals, item_f, num_users, K, reg)
@@ -328,3 +330,48 @@ class TestInference:
         s = predict_scores(jnp.ones(4), jnp.ones((7, 4)))
         assert s.shape == (7,)
         np.testing.assert_allclose(np.asarray(s), 4.0)
+
+
+class TestPallasSolver:
+    def test_interpret_kernel_matches_cholesky(self):
+        from predictionio_tpu.ops.solve import cholesky_solve, spd_solve
+
+        rng = np.random.default_rng(0)
+        B, K = 40, 16
+        M = rng.normal(size=(B, K, K)).astype(np.float32)
+        A = jnp.asarray(M @ M.transpose(0, 2, 1) + 5 * np.eye(K, dtype=np.float32))
+        b = jnp.asarray(rng.normal(size=(B, K)).astype(np.float32))
+        x_ref = np.asarray(cholesky_solve(A, b))
+        x = np.asarray(spd_solve(A, b, method="pallas_interpret"))
+        np.testing.assert_allclose(x, x_ref, rtol=5e-4, atol=5e-5)
+
+    def test_train_with_pallas_interpret_matches_cholesky(self):
+        rows, cols, vals, _ = synthetic_ratings()
+        ref = train_als(rows, cols, vals, 60, 40,
+                        ALSConfig(rank=8, iterations=3, solver="cholesky"))
+        got = train_als(rows, cols, vals, 60, 40,
+                        ALSConfig(rank=8, iterations=3, solver="pallas_interpret"))
+        np.testing.assert_allclose(
+            np.asarray(got.user), np.asarray(ref.user), rtol=5e-3, atol=5e-4
+        )
+
+    def test_invalid_solver_rejected(self):
+        rows, cols, vals, _ = synthetic_ratings()
+        with pytest.raises(ValueError, match="solver"):
+            train_als(rows, cols, vals, 60, 40, ALSConfig(solver="qr"))
+
+    def test_non_multiple_rank_falls_back(self):
+        # rank 10 is not a multiple of the pivot block; spd_solve must
+        # quietly use cholesky instead of crashing
+        from predictionio_tpu.ops.solve import spd_solve, cholesky_solve
+
+        rng = np.random.default_rng(1)
+        B, K = 8, 10
+        M = rng.normal(size=(B, K, K)).astype(np.float32)
+        A = jnp.asarray(M @ M.transpose(0, 2, 1) + 5 * np.eye(K, dtype=np.float32))
+        b = jnp.asarray(rng.normal(size=(B, K)).astype(np.float32))
+        np.testing.assert_allclose(
+            np.asarray(spd_solve(A, b, method="pallas_interpret")),
+            np.asarray(cholesky_solve(A, b)),
+            rtol=1e-5, atol=1e-6,
+        )
