@@ -129,7 +129,13 @@ class _Chunked(NamedTuple):
 
 
 class BucketedRatings(NamedTuple):
-    """One side of the ratings matrix in solver layout."""
+    """One side of the ratings matrix in solver layout.
+
+    Registered as a custom pytree below: the array fields (``normal``,
+    ``hot``, ``hot_rows``) are children; the int metadata travels in the
+    treedef so it stays STATIC under jit (a multi-process jit must not
+    receive per-host scalar leaves, and the sentinel row index wants to
+    be a compile-time constant)."""
 
     normal: tuple  # tuple[_Chunked, ...] — rows fitting one segment
     hot: tuple  # tuple[_Chunked, ...] — segments of hot rows (row_id = slot)
@@ -138,6 +144,14 @@ class BucketedRatings(NamedTuple):
     num_cols: int
     nnz: int  # real entries
     padded_nnz: int  # entries incl. padding (MXU work actually done)
+
+
+jax.tree_util.register_pytree_node(
+    BucketedRatings,
+    lambda b: ((b.normal, b.hot, b.hot_rows),
+               (b.num_rows, b.num_cols, b.nnz, b.padded_nnz)),
+    lambda aux, ch: BucketedRatings(ch[0], ch[1], ch[2], *aux),
+)
 
 
 def _chunk(arrs: list, n: int, c: int, l: int) -> _Chunked:
@@ -181,6 +195,86 @@ def _fill_bucket(
     return [row_id, idx, val, mask]
 
 
+class _Segments(NamedTuple):
+    """Host-side segmentation of one COO shard (pre-padding layout)."""
+
+    per_width: dict  # width -> (seg_row int32, seg_start, seg_len)
+    hot_slot: np.ndarray  # local hot-slot id per hot segment
+    hot_start: np.ndarray
+    hot_len: np.ndarray
+    hot_rows: np.ndarray  # [H_local] row ids of hot rows
+    w_max: int
+    cols_s: np.ndarray  # row-sorted column ids
+    vals_s: np.ndarray  # row-sorted values
+    rated: np.ndarray  # bool [num_rows] — rows present in this shard
+
+
+def _segment(
+    rows: np.ndarray,
+    cols: np.ndarray,
+    vals: np.ndarray,
+    num_rows: int,
+    num_cols: int,
+    widths: Sequence[int],
+) -> _Segments:
+    """Validate + sort one COO shard and split every row into fixed-width
+    segments: rows with <= max(widths) ratings get one segment in the
+    smallest fitting width; hotter rows get ceil(count/w_max) segments."""
+    rows = np.asarray(rows, dtype=np.int64)
+    cols = np.asarray(cols, dtype=np.int64)
+    vals = np.asarray(vals, dtype=np.float32)
+    if not (rows.shape == cols.shape == vals.shape):
+        raise ValueError("rows/cols/vals must be 1-D arrays of equal length")
+    if rows.size and (rows.min() < 0 or rows.max() >= num_rows):
+        raise ValueError("row index out of range")
+    if cols.size and (cols.min() < 0 or cols.max() >= num_cols):
+        raise ValueError("column index out of range")
+
+    usable = sorted({int(w) for w in widths if w >= 1})
+    if not usable:
+        raise ValueError("widths must contain at least one positive width")
+    w_max = usable[-1]
+
+    order = np.argsort(rows, kind="stable")
+    cols_s, vals_s = cols[order], vals[order]
+    uniq, starts, counts = np.unique(rows[order], return_index=True, return_counts=True)
+    rated = np.zeros(num_rows, dtype=bool)
+    rated[uniq] = True
+
+    is_hot = counts > w_max
+    per_width: dict = {}
+    lo = 0
+    for w in usable:
+        sel = np.nonzero(~is_hot & (counts > lo) & (counts <= w))[0]
+        lo = w
+        if sel.size:
+            per_width[w] = (uniq[sel].astype(np.int32), starts[sel], counts[sel])
+
+    hot_sel = np.nonzero(is_hot)[0]
+    num_hot = int(hot_sel.size)
+    if num_hot:
+        h_counts = counts[hot_sel]
+        n_segs = -(-h_counts // w_max)  # per hot row
+        hot_slot = np.repeat(np.arange(num_hot, dtype=np.int32), n_segs)
+        # segment k of a row starts at row_start + k*w_max
+        seg_k = np.arange(int(n_segs.sum())) - np.repeat(
+            np.cumsum(n_segs) - n_segs, n_segs
+        )
+        hot_start = np.repeat(starts[hot_sel], n_segs) + seg_k * w_max
+        hot_len = np.minimum(
+            np.repeat(h_counts, n_segs) - seg_k * w_max, w_max
+        ).astype(np.int64)
+        hot_rows = uniq[hot_sel].astype(np.int32)
+    else:
+        hot_slot = np.zeros(0, np.int32)
+        hot_start = np.zeros(0, np.int64)
+        hot_len = np.zeros(0, np.int64)
+        hot_rows = np.zeros(0, np.int32)
+    return _Segments(
+        per_width, hot_slot, hot_start, hot_len, hot_rows, w_max, cols_s, vals_s, rated
+    )
+
+
 def build_buckets(
     rows: np.ndarray,
     cols: np.ndarray,
@@ -202,26 +296,8 @@ def build_buckets(
     shard evenly). Rows with zero ratings are absent — ``train_als``
     zeroes their factors via the rated-row mask.
     """
-    rows = np.asarray(rows, dtype=np.int64)
-    cols = np.asarray(cols, dtype=np.int64)
-    vals = np.asarray(vals, dtype=np.float32)
-    if not (rows.shape == cols.shape == vals.shape):
-        raise ValueError("rows/cols/vals must be 1-D arrays of equal length")
-    if rows.size and (rows.min() < 0 or rows.max() >= num_rows):
-        raise ValueError("row index out of range")
-    if cols.size and (cols.min() < 0 or cols.max() >= num_cols):
-        raise ValueError("column index out of range")
-
-    usable = sorted({int(w) for w in widths if w >= 1})
-    if not usable:
-        raise ValueError("widths must contain at least one positive width")
-    w_max = usable[-1]
-
-    order = np.argsort(rows, kind="stable")
-    cols_s, vals_s = cols[order], vals[order]
-    uniq, starts, counts = np.unique(rows[order], return_index=True, return_counts=True)
-
-    nnz = int(rows.size)
+    seg = _segment(rows, cols, vals, num_rows, num_cols, widths)
+    nnz = int(np.asarray(rows).size)
     padded_nnz = 0
     normal_chunks: list = []
     hot_chunks: list = []
@@ -236,43 +312,23 @@ def build_buckets(
         n_pad = n_chunks * c
         padded_nnz += n_pad * width
         arrs = _fill_bucket(
-            n_seg, n_pad, width, seg_row, seg_start, seg_len, cols_s, vals_s, sentinel
+            n_seg, n_pad, width, seg_row, seg_start, seg_len,
+            seg.cols_s, seg.vals_s, sentinel,
         )
         return _chunk(arrs, n_chunks, c, width)
 
-    # --- normal rows: one segment in the smallest width that fits --------
-    is_hot = counts > w_max
-    lo = 0
-    for w in usable:
-        sel = np.nonzero(~is_hot & (counts > lo) & (counts <= w))[0]
-        lo = w
-        if sel.size == 0:
-            continue
-        normal_chunks.append(
-            pack(
-                uniq[sel].astype(np.int32), starts[sel], counts[sel], w, num_rows
-            )
-        )
+    for w in sorted(seg.per_width):
+        seg_row, seg_start, seg_len = seg.per_width[w]
+        normal_chunks.append(pack(seg_row, seg_start, seg_len, w, num_rows))
 
-    # --- hot rows: split into w_max-wide segments, Gramian-accumulated ---
-    hot_sel = np.nonzero(is_hot)[0]
-    num_hot = int(hot_sel.size)
+    num_hot = int(seg.hot_rows.size)
     if num_hot:
-        h_counts = counts[hot_sel]
-        n_segs = -(-h_counts // w_max)  # per hot row
-        slot = np.repeat(np.arange(num_hot, dtype=np.int32), n_segs)
-        # segment k of a row starts at row_start + k*w_max
-        seg_k = np.arange(int(n_segs.sum())) - np.repeat(
-            np.cumsum(n_segs) - n_segs, n_segs
+        hot_chunks.append(
+            pack(seg.hot_slot, seg.hot_start, seg.hot_len, seg.w_max, num_hot)
         )
-        seg_start = np.repeat(starts[hot_sel], n_segs) + seg_k * w_max
-        seg_len = np.minimum(
-            np.repeat(h_counts, n_segs) - seg_k * w_max, w_max
-        ).astype(np.int64)
-        hot_chunks.append(pack(slot, seg_start, seg_len, w_max, num_hot))
     hot_rows = np.full(num_hot + 1, num_rows, dtype=np.int32)
     if num_hot:
-        hot_rows[:num_hot] = uniq[hot_sel]
+        hot_rows[:num_hot] = seg.hot_rows
 
     return BucketedRatings(
         tuple(normal_chunks),
@@ -546,6 +602,164 @@ def _device_buckets(
     )
 
 
+def _multihost_bucketed(
+    rows: np.ndarray,
+    cols: np.ndarray,
+    vals: np.ndarray,
+    num_rows: int,
+    num_cols: int,
+    mesh: Mesh,
+    data_axis: str,
+    widths: Sequence[int],
+    chunk_entries: int,
+) -> tuple[BucketedRatings, np.ndarray]:
+    """Multi-host: per-host COO shards -> GLOBAL sharded bucket arrays
+    without ever materializing the global rating set on one host
+    (VERDICT round-1 missing #3 — replaces :func:`_allgather_coo`).
+
+    1. All-to-all the shard so host ``p`` owns every rating of rows with
+       ``row % P == p`` (bounded-memory exchange, O(nnz/P) steady state).
+    2. Each host segments its rows locally (complete rows -> correct
+       counts), then all hosts agree on per-width block shapes (a tiny
+       metadata all-gather) so every host packs an identically-shaped
+       block per bucket.
+    3. ``jax.make_array_from_process_local_data`` assembles the global
+       [n_chunks, P*c_local, L] arrays with the chunk-row axis sharded
+       over ``data_axis`` (process-contiguous blocks — the mesh must be
+       built over ``jax.devices()`` in process order, which
+       ``mesh_context()`` does).
+
+    Returns (bucketed ratings with global device arrays, this-host rated
+    mask — OR it across hosts for the global mask).
+    """
+    from jax.experimental import multihost_utils  # noqa: F401  (doc pointer)
+
+    from predictionio_tpu.parallel.exchange import allgather_objects, exchange_by_owner
+
+    P = jax.process_count()
+    me = jax.process_index()
+    rows = np.asarray(rows, dtype=np.int64)
+    cols = np.asarray(cols, dtype=np.int64)
+    vals = np.asarray(vals, dtype=np.float32)
+    # validate BEFORE the exchange: a bad index must fail on the host that
+    # holds it, not strand the peers in the next collective
+    if rows.size and (rows.min() < 0 or rows.max() >= num_rows):
+        raise ValueError("row index out of range")
+    if cols.size and (cols.min() < 0 or cols.max() >= num_cols):
+        raise ValueError("column index out of range")
+
+    rows, cols, vals = exchange_by_owner([rows, cols, vals], rows % P)
+    seg = _segment(rows, cols, vals, num_rows, num_cols, widths)
+
+    data_size = int(mesh.shape[data_axis])
+    if data_size % P:
+        raise ValueError(
+            f"data axis ({data_size}) must divide evenly across {P} processes"
+        )
+    dpl = data_size // P  # data-axis devices per process
+    m = int(np.lcm(8, dpl))
+
+    # --- agree on per-width shapes (tiny metadata gather) -----------------
+    local_meta = {
+        "widths": {w: int(seg.per_width[w][0].size) for w in seg.per_width},
+        "hot_segs": int(seg.hot_slot.size),
+        "num_hot": int(seg.hot_rows.size),
+        "nnz": int(rows.size),
+    }
+    metas = allgather_objects(local_meta)
+    all_widths = sorted({w for mt in metas for w in mt["widths"]})
+    hot_counts = [mt["num_hot"] for mt in metas]
+    hot_offset = int(np.sum(hot_counts[:me]))
+    num_hot_tot = int(np.sum(hot_counts))
+    nnz_global = int(np.sum([mt["nnz"] for mt in metas]))
+
+    def plan(width: int, n_seg_max: int) -> tuple[int, int]:
+        """(c_local, n_chunks): every host pads its block to the same
+        n_chunks * c_local rows; global chunk rows C = P * c_local."""
+        budget = max(1, chunk_entries // (width * P))
+        c_local = max(m, budget // m * m)
+        c_local = min(c_local, -(-max(n_seg_max, 1) // m) * m)
+        return c_local, -(-max(n_seg_max, 1) // c_local)
+
+    sharding3 = NamedSharding(mesh, PartitionSpec(None, data_axis, None))
+    sharding2 = NamedSharding(mesh, PartitionSpec(None, data_axis))
+
+    padded_global = 0
+
+    def assemble(seg_row, seg_start, seg_len, width, sentinel, n_seg_max):
+        """Pack this host's block and build the global sharded arrays."""
+        nonlocal padded_global
+        c_local, n_chunks = plan(width, n_seg_max)
+        n_pad = n_chunks * c_local
+        padded_global += n_pad * width * P
+        row_id, idx, val, mask = _fill_bucket(
+            int(seg_row.size), n_pad, width, seg_row, seg_start, seg_len,
+            seg.cols_s, seg.vals_s, sentinel,
+        )
+        glob3 = (n_chunks, P * c_local, width)
+        glob2 = (n_chunks, P * c_local)
+        return _Chunked(
+            jax.make_array_from_process_local_data(
+                sharding2, row_id.reshape(n_chunks, c_local), glob2
+            ),
+            jax.make_array_from_process_local_data(
+                sharding3, idx.reshape(n_chunks, c_local, width), glob3
+            ),
+            jax.make_array_from_process_local_data(
+                sharding3, val.reshape(n_chunks, c_local, width), glob3
+            ),
+            jax.make_array_from_process_local_data(
+                sharding3, mask.reshape(n_chunks, c_local, width), glob3
+            ),
+        )
+
+    empty_i64 = np.zeros(0, np.int64)
+    normal_chunks = []
+    for w in all_widths:
+        n_seg_max = max(mt["widths"].get(w, 0) for mt in metas)
+        seg_row, seg_start, seg_len = seg.per_width.get(
+            w, (np.zeros(0, np.int32), empty_i64, empty_i64)
+        )
+        normal_chunks.append(
+            assemble(seg_row, seg_start, seg_len, w, num_rows, n_seg_max)
+        )
+
+    hot_chunks = []
+    if num_hot_tot:
+        n_seg_max = max(mt["hot_segs"] for mt in metas)
+        # local slots shift to the global slot space; padding segments hit
+        # the global sentinel slot num_hot_tot
+        hot_chunks.append(
+            assemble(
+                seg.hot_slot + hot_offset, seg.hot_start, seg.hot_len,
+                seg.w_max, num_hot_tot, n_seg_max,
+            )
+        )
+    hot_rows = np.full(num_hot_tot + 1, num_rows, dtype=np.int32)
+    if num_hot_tot:
+        gathered_hot = allgather_objects(seg.hot_rows.tolist())
+        hot_rows[:num_hot_tot] = np.concatenate(
+            [np.asarray(h, np.int32) for h in gathered_hot]
+        )
+    # a raw numpy leaf must not enter a multi-process jit — materialize the
+    # (identical-everywhere) slot map as a replicated global array
+    hot_rows_dev = jax.make_array_from_callback(
+        hot_rows.shape, NamedSharding(mesh, PartitionSpec(None)),
+        lambda idx: hot_rows[idx],
+    )
+
+    bucketed = BucketedRatings(
+        tuple(normal_chunks),
+        tuple(hot_chunks),
+        hot_rows_dev,
+        num_rows,
+        num_cols,
+        nnz_global,
+        padded_global,
+    )
+    return bucketed, seg.rated
+
+
 def _allgather_coo(
     rows: np.ndarray, cols: np.ndarray, vals: np.ndarray
 ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
@@ -594,9 +808,11 @@ def train_als(
 ) -> ALSFactors:
     """Train factor matrices from COO ratings.
 
-    In a multi-process job, ``rows/cols/vals`` may be this host's shard of
-    the ratings (the sharded event-reader layout); they are all-gathered
-    once so bucket construction is globally consistent.
+    In a multi-process job, ``rows/cols/vals`` are this host's shard of
+    the ratings (the sharded event-reader layout). With a mesh, shards are
+    re-partitioned by row through a bounded-memory exchange — per-host
+    memory stays O(nnz / num_hosts) (see :func:`_multihost_bucketed`);
+    without a mesh they are all-gathered (legacy replicated fallback).
 
     Returns host-strippable ``ALSFactors`` with the sentinel rows removed:
     ``user [num_users, K]``, ``item [num_items, K]``.
@@ -621,28 +837,53 @@ def train_als(
         # a data-only mesh (e.g. `pio train --mesh data=8`): fall back to
         # replicated factor tables
         model_axis = None
-    if jax.process_count() > 1:
-        rows, cols, vals = _allgather_coo(
-            np.asarray(rows), np.asarray(cols), np.asarray(vals)
+    multihost = jax.process_count() > 1
+    if multihost and mesh is not None:
+        # bounded-memory path: per-host shards stay sharded; only rows are
+        # re-partitioned (VERDICT round-1 missing #3)
+        from predictionio_tpu.parallel.exchange import allgather_objects
+
+        user_bucketed, u_rated = _multihost_bucketed(
+            rows, cols, vals, num_users, num_items, mesh, data_axis,
+            config.bucket_widths, config.chunk_entries,
         )
+        item_bucketed, i_rated = _multihost_bucketed(
+            cols, rows, vals, num_items, num_users, mesh, data_axis,
+            config.bucket_widths, config.chunk_entries,
+        )
+        # the global rated mask is the OR of the per-host masks
+        u_rated = np.bitwise_or.reduce(allgather_objects(np.packbits(u_rated)))
+        i_rated = np.bitwise_or.reduce(allgather_objects(np.packbits(i_rated)))
+        u_rated = np.unpackbits(u_rated, count=num_users).astype(bool)
+        i_rated = np.unpackbits(i_rated, count=num_items).astype(bool)
+    else:
+        if multihost:
+            # mesh-less multi-process training: legacy replicated path
+            rows, cols, vals = _allgather_coo(
+                np.asarray(rows), np.asarray(cols), np.asarray(vals)
+            )
+        row_multiple = 8
+        if mesh is not None:
+            # chunk rows must divide evenly over the data axis
+            row_multiple = int(np.lcm(8, mesh.shape.get(data_axis, 1)))
+        user_b = build_buckets(
+            rows, cols, vals, num_users, num_items,
+            widths=config.bucket_widths, row_multiple=row_multiple,
+            chunk_entries=config.chunk_entries,
+        )
+        item_b = build_buckets(
+            cols, rows, vals, num_items, num_users,
+            widths=config.bucket_widths, row_multiple=row_multiple,
+            chunk_entries=config.chunk_entries,
+        )
+        u_rated = rated_row_mask(user_b)
+        i_rated = rated_row_mask(item_b)
+        user_bucketed = _device_buckets(user_b, mesh, data_axis)
+        item_bucketed = _device_buckets(item_b, mesh, data_axis)
+
     rank = config.rank
     if config.rank_pad_multiple:
         rank = -(-rank // config.rank_pad_multiple) * config.rank_pad_multiple
-
-    row_multiple = 8
-    if mesh is not None:
-        # chunk rows must divide evenly over the data axis
-        row_multiple = int(np.lcm(8, mesh.shape.get(data_axis, 1)))
-    user_b = build_buckets(
-        rows, cols, vals, num_users, num_items,
-        widths=config.bucket_widths, row_multiple=row_multiple,
-        chunk_entries=config.chunk_entries,
-    )
-    item_b = build_buckets(
-        cols, rows, vals, num_items, num_users,
-        widths=config.bucket_widths, row_multiple=row_multiple,
-        chunk_entries=config.chunk_entries,
-    )
 
     key_u, key_i = jax.random.split(jax.random.PRNGKey(config.seed))
     scale = 1.0 / np.sqrt(rank)
@@ -654,8 +895,8 @@ def train_als(
     # MLlib seeds factors with abs(normal)/sqrt(rank) — keeps implicit ALS
     # preferences non-negative at iteration 0. Unrated rows are zeroed so
     # cold entities never outscore trained ones (round-1 advisor fix).
-    u_mask = np.append(rated_row_mask(user_b), False)[:, None]
-    i_mask = np.append(rated_row_mask(item_b), False)[:, None]
+    u_mask = np.append(u_rated, False)[:, None]
+    i_mask = np.append(i_rated, False)[:, None]
     # draw at the canonical (num_rows+1) shape so the init — and therefore
     # the trained factors — are identical across mesh shapes, then zero-pad
     uf = jnp.abs(jax.random.normal(key_u, (num_users + 1, rank), jnp.float32)) * scale
@@ -666,11 +907,19 @@ def train_als(
         # persistent tables sharded over the model axis (ALX): catalog
         # memory scales with the mesh instead of being replicated
         model_sharded = NamedSharding(mesh, PartitionSpec(model_axis, None))
-        uf = jax.device_put(uf, model_sharded)
-        vf = jax.device_put(vf, model_sharded)
-
-    user_bucketed = _device_buckets(user_b, mesh, data_axis)
-    item_bucketed = _device_buckets(item_b, mesh, data_axis)
+        if multihost:
+            # every host holds the identical full table; carve out the
+            # addressable shards (device_put cannot target a global mesh)
+            uf_h, vf_h = np.asarray(uf), np.asarray(vf)
+            uf = jax.make_array_from_callback(
+                uf_h.shape, model_sharded, lambda idx: uf_h[idx]
+            )
+            vf = jax.make_array_from_callback(
+                vf_h.shape, model_sharded, lambda idx: vf_h[idx]
+            )
+        else:
+            uf = jax.device_put(uf, model_sharded)
+            vf = jax.device_put(vf, model_sharded)
 
     manager = None
     start_step = 0
@@ -714,10 +963,11 @@ def train_als(
     if mesh is not None:
         # replicate before stripping the sentinel row: callers consume the
         # factors as plain (host) arrays, and slicing a model-sharded table
-        # would otherwise need an ambiguous-sharding gather
+        # would otherwise need an ambiguous-sharding gather. A jitted
+        # identity performs the reshard on any topology (device_put cannot
+        # retarget a multi-process mesh).
         replicated = NamedSharding(mesh, PartitionSpec())
-        uf = jax.device_put(uf, replicated)
-        vf = jax.device_put(vf, replicated)
+        uf, vf = jax.jit(lambda a, b: (a, b), out_shardings=replicated)(uf, vf)
     return ALSFactors(user=uf[:num_users], item=vf[:num_items])
 
 

@@ -108,7 +108,6 @@ class ECommerceDataSource(DataSource):
             weight = 5.0 if e.event == p.buy_event else 1.0
             key = (e.entity_id, e.target_entity_id)
             counts[key] = counts.get(key, 0.0) + weight
-        user_index = BiMap.string_index(u for u, _ in counts)
         categories: dict[str, tuple] = {}
         for item_id, pm in PEventStore.aggregate_properties(
             app_name=p.app_name, entity_type=p.item_entity_type
@@ -116,13 +115,34 @@ class ECommerceDataSource(DataSource):
             categories[item_id] = tuple(
                 str(c) for c in pm.opt("categories", list, [])
             )
-        item_index = BiMap.string_index(list(i for _, i in counts) + list(categories))
+        if ctx.num_hosts > 1:
+            # cross-host coherence (round-1 advisor high finding): merge
+            # per-host weighted counts, build identical global BiMaps, and
+            # sum popularity across hosts
+            import operator
+
+            from predictionio_tpu.parallel.exchange import global_vocab, merge_keyed
+
+            counts = merge_keyed(counts, combine=operator.add)
+            user_index = BiMap.string_index(global_vocab(u for u, _ in counts))
+            item_index = BiMap.string_index(
+                global_vocab(list(i for _, i in counts) + list(categories))
+            )
+        else:
+            user_index = BiMap.string_index(u for u, _ in counts)
+            item_index = BiMap.string_index(
+                list(i for _, i in counts) + list(categories)
+            )
         n = len(counts)
         rows = np.fromiter((user_index[u] for u, _ in counts), np.int64, n)
         cols = np.fromiter((item_index[i] for _, i in counts), np.int64, n)
         vals = np.fromiter(counts.values(), np.float32, n)
         popularity = np.zeros(len(item_index), dtype=np.float32)
         np.add.at(popularity, cols, vals)
+        if ctx.num_hosts > 1:
+            from predictionio_tpu.parallel.exchange import global_sum_array
+
+            popularity = global_sum_array(popularity)
         return TrainingData(
             rows, cols, vals, user_index, item_index, categories, popularity
         )

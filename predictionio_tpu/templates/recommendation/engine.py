@@ -147,19 +147,39 @@ class RecommendationDataSource(DataSource):
             # latest event per (user, item) wins
             if prev is None or e.event_time >= prev[0]:
                 ratings[key] = (e.event_time, rating)
+        if ctx.num_hosts > 1:
+            # cross-host coherence (round-1 advisor high finding): events of
+            # one (user, item) pair may land in different hosts' shards; the
+            # bounded exchange re-partitions by user and applies the SAME
+            # latest-wins rule globally. The COO stays host-local.
+            from predictionio_tpu.parallel.exchange import merge_keyed
+
+            ratings = merge_keyed(ratings, combine=max)
         return [(u, i, r) for (u, i), (_, r) in ratings.items()]
 
     @staticmethod
-    def _to_training_data(triples: Sequence[tuple[str, str, float]]) -> TrainingData:
-        user_index = BiMap.string_index(u for u, _, _ in triples)
-        item_index = BiMap.string_index(i for _, i, _ in triples)
+    def _to_training_data(
+        triples: Sequence[tuple[str, str, float]],
+        ctx: WorkflowContext | None = None,
+    ) -> TrainingData:
+        if ctx is not None and ctx.num_hosts > 1:
+            # every host must build IDENTICAL global BiMaps (the advisor's
+            # round-1 high finding: per-host index spaces break the sharded
+            # device_put); only the sorted vocabularies are all-gathered
+            from predictionio_tpu.parallel.exchange import global_vocab
+
+            user_index = BiMap.string_index(global_vocab(u for u, _, _ in triples))
+            item_index = BiMap.string_index(global_vocab(i for _, i, _ in triples))
+        else:
+            user_index = BiMap.string_index(u for u, _, _ in triples)
+            item_index = BiMap.string_index(i for _, i, _ in triples)
         rows = np.fromiter((user_index[u] for u, _, _ in triples), np.int64, len(triples))
         cols = np.fromiter((item_index[i] for _, i, _ in triples), np.int64, len(triples))
         vals = np.fromiter((r for _, _, r in triples), np.float32, len(triples))
         return TrainingData(rows, cols, vals, user_index, item_index)
 
     def read_training(self, ctx: WorkflowContext) -> TrainingData:
-        return self._to_training_data(self._read_ratings(ctx))
+        return self._to_training_data(self._read_ratings(ctx), ctx)
 
     def read_eval(self, ctx: WorkflowContext):
         """K-fold split by stable hash of (user, item): train on k-1 folds,
@@ -177,7 +197,7 @@ class RecommendationDataSource(DataSource):
         for fold in range(k):
             train = [t for t in triples if fold_of(t[0], t[1]) != fold]
             held = [t for t in triples if fold_of(t[0], t[1]) == fold]
-            td = self._to_training_data(train)
+            td = self._to_training_data(train, ctx)
             seen_by_user: dict[str, set] = {}
             for u, i, _ in train:
                 seen_by_user.setdefault(u, set()).add(i)

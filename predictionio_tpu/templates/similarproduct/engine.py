@@ -97,7 +97,6 @@ class SimilarProductDataSource(DataSource):
                 continue
             key = (e.entity_id, e.target_entity_id)
             counts[key] = counts.get(key, 0.0) + 1.0
-        user_index = BiMap.string_index(u for u, _ in counts)
         # include $set-only items so catalog filters work for unviewed items
         categories: dict[str, tuple] = {}
         item_props = PEventStore.aggregate_properties(
@@ -106,9 +105,24 @@ class SimilarProductDataSource(DataSource):
         for item_id, pm in item_props.items():
             cats = pm.opt("categories", list, [])
             categories[item_id] = tuple(str(c) for c in cats)
-        item_index = BiMap.string_index(
-            list(i for _, i in counts) + list(categories)
-        )
+        if ctx.num_hosts > 1:
+            # cross-host coherence (round-1 advisor high finding): merge
+            # per-host view counts by user, then build IDENTICAL global
+            # BiMaps on every host from sorted vocabularies
+            import operator
+
+            from predictionio_tpu.parallel.exchange import global_vocab, merge_keyed
+
+            counts = merge_keyed(counts, combine=operator.add)
+            user_index = BiMap.string_index(global_vocab(u for u, _ in counts))
+            item_index = BiMap.string_index(
+                global_vocab(list(i for _, i in counts) + list(categories))
+            )
+        else:
+            user_index = BiMap.string_index(u for u, _ in counts)
+            item_index = BiMap.string_index(
+                list(i for _, i in counts) + list(categories)
+            )
         n = len(counts)
         rows = np.fromiter((user_index[u] for u, _ in counts), np.int64, n)
         cols = np.fromiter((item_index[i] for _, i in counts), np.int64, n)
