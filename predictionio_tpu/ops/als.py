@@ -44,6 +44,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import logging
 from typing import Any, NamedTuple, Sequence
 
 import jax
@@ -641,12 +642,18 @@ def _multihost_bucketed(
     rows = np.asarray(rows, dtype=np.int64)
     cols = np.asarray(cols, dtype=np.int64)
     vals = np.asarray(vals, dtype=np.float32)
-    # validate BEFORE the exchange: a bad index must fail on the host that
-    # holds it, not strand the peers in the next collective
+    # validate BEFORE the exchange, then agree on the verdict: a lone
+    # raise would strand the peers in the next collective until the
+    # distributed timeout, so every host gathers the error flags and they
+    # all raise together (round-2 advisor finding)
+    err = ""
     if rows.size and (rows.min() < 0 or rows.max() >= num_rows):
-        raise ValueError("row index out of range")
-    if cols.size and (cols.min() < 0 or cols.max() >= num_cols):
-        raise ValueError("column index out of range")
+        err = f"process {me}: row index out of range"
+    elif cols.size and (cols.min() < 0 or cols.max() >= num_cols):
+        err = f"process {me}: column index out of range"
+    errors = [e for e in allgather_objects(err) if e]
+    if errors:
+        raise ValueError("; ".join(errors))
 
     rows, cols, vals = exchange_by_owner([rows, cols, vals], rows % P)
     seg = _segment(rows, cols, vals, num_rows, num_cols, widths)
@@ -833,6 +840,14 @@ def train_als(
         # portable Cholesky until the kernel is shard_map-wrapped
         on_tpu = jax.default_backend() == "tpu"
         solver = "pallas" if (on_tpu and mesh is None) else "cholesky"
+    elif solver.startswith("pallas") and mesh is not None:
+        # an explicit kernel request on a sharded sweep would compile the
+        # single-device pallas_call under GSPMD — downgrade instead of
+        # failing (covers "pallas" and "pallas_interpret" alike)
+        logging.getLogger(__name__).warning(
+            "solver=%r is single-device; using 'cholesky' on the mesh", solver
+        )
+        solver = "cholesky"
     if mesh is not None and model_axis not in mesh.shape:
         # a data-only mesh (e.g. `pio train --mesh data=8`): fall back to
         # replicated factor tables
@@ -921,6 +936,43 @@ def train_als(
             uf = jax.device_put(uf, model_sharded)
             vf = jax.device_put(vf, model_sharded)
 
+    def _to_canonical(u: jax.Array, v: jax.Array) -> dict:
+        """Checkpoint state at the canonical (num_rows+1, K) replicated
+        shape: the on-disk layout must not depend on the mesh's model-axis
+        size, or a resume on a different mesh fails the shape match
+        (round-2 advisor finding). Always returns FRESH buffers (copies on
+        the mesh-less path) so the async orbax save can overlap the next
+        sweep, whose donation would otherwise race the live tables."""
+        if mesh is None:
+            return {"user": jnp.copy(u), "item": jnp.copy(v)}
+        rep = NamedSharding(mesh, PartitionSpec())
+
+        def strip(a, b):
+            # replicate BEFORE slicing: the canonical length need not
+            # divide the model axis, so a sharded-dim slice is illegal
+            # (reshard, not with_sharding_constraint — the latter doesn't
+            # change the sharded *type* under explicit-sharding meshes)
+            a = jax.sharding.reshard(a, rep)
+            b = jax.sharding.reshard(b, rep)
+            return a[: num_users + 1], b[: num_items + 1]
+
+        cu, ci = jax.jit(strip, out_shardings=rep)(u, v)
+        return {"user": cu, "item": ci}
+
+    def _from_canonical(state: dict) -> tuple[jax.Array, jax.Array]:
+        """Re-pad restored canonical factors to this mesh's table shape
+        and reshard them over the model axis."""
+        u, v = state["user"], state["item"]
+        if mesh is None:
+            return u, v
+        return jax.jit(
+            lambda a, b: (
+                jnp.pad(a, ((0, n_u - (num_users + 1)), (0, 0))),
+                jnp.pad(b, ((0, n_i - (num_items + 1)), (0, 0))),
+            ),
+            out_shardings=NamedSharding(mesh, PartitionSpec(model_axis, None)),
+        )(u, v)
+
     manager = None
     start_step = 0
     if config.checkpoint_dir:
@@ -929,15 +981,24 @@ def train_als(
         manager = CheckpointManager(config.checkpoint_dir)
         latest = manager.latest_step()
         if latest is not None:
-            state = manager.restore(latest, like={"user": uf, "item": vf})
-            uf, vf = state["user"], state["item"]
-            # a completed run restores and short-circuits the sweep loop
-            start_step = min(latest, config.iterations)
-            import logging
-
-            logging.getLogger(__name__).info(
-                "Resumed ALS from checkpoint step %d", latest
-            )
+            like = _to_canonical(uf, vf)
+            try:
+                state = manager.restore(latest, like=like)
+            except (ValueError, TypeError, KeyError) as exc:
+                # shape/structure drift only (e.g. a pre-canonical padded
+                # checkpoint, or a different rank); transient I/O errors
+                # propagate rather than silently restarting from step 0
+                logging.getLogger(__name__).warning(
+                    "Checkpoint step %d is incompatible with this run "
+                    "(%s); starting fresh", latest, exc,
+                )
+            else:
+                uf, vf = _from_canonical(state)
+                # a completed run restores and short-circuits the sweep loop
+                start_step = min(latest, config.iterations)
+                logging.getLogger(__name__).info(
+                    "Resumed ALS from checkpoint step %d", latest
+                )
 
     for step in range(start_step, config.iterations):
         uf, vf = als_sweep(
@@ -953,10 +1014,9 @@ def train_als(
             (step + 1) % config.checkpoint_interval == 0
             or step + 1 == config.iterations
         ):
-            manager.save(step + 1, {"user": uf, "item": vf})
-            # block: the next sweep donates these buffers, so an async
-            # save must not still be reading them
-            manager.wait()
+            # _to_canonical hands the save fresh buffers, so the async
+            # write overlaps the next sweep instead of serializing it
+            manager.save(step + 1, _to_canonical(uf, vf))
     if manager is not None:
         manager.wait()
         manager.close()

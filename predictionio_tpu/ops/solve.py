@@ -31,10 +31,27 @@ from jax.experimental.pallas import tpu as pltpu
 
 __all__ = ["spd_solve", "gj_solve_pallas", "cholesky_solve"]
 
-#: rows per kernel block: [32, K, K] f32 at K=64 is 0.5 MB for A; the
-#: loop-carried working copy, MXU operand copies, and pipelining
-#: double-buffers keep the total under the ~16 MB VMEM budget.
+#: rows per kernel block at K<=64: [32, K, K] f32 at K=64 is 0.5 MB for
+#: A; the loop-carried working copy, MXU operand copies, and pipelining
+#: double-buffers keep the total under the ~16 MB VMEM budget. Larger K
+#: scales the block down (see _auto_block_rows) so the working set stays
+#: bounded instead of blowing VMEM at rank >= ~180.
 _BLOCK_ROWS = 32
+
+#: VMEM budget for the [TB, K, K] A block alone; the kernel's live copies
+#: (A, the rank-P update operands, b, pipeline double-buffers) are a small
+#: constant multiple of it, so 4 MB keeps the total inside ~16 MB.
+_BLOCK_BYTES = 4 << 20
+
+#: above this K even a single-row block's K*K working set (plus copies)
+#: crowds VMEM — spd_solve falls back to Cholesky.
+_MAX_PALLAS_K = 512
+
+
+def _auto_block_rows(K: int) -> int:
+    """Largest block_rows (capped at _BLOCK_ROWS) whose [TB,K,K] f32 A
+    block fits _BLOCK_BYTES: 32 through K=128, then 16/8/... down to 1."""
+    return max(1, min(_BLOCK_ROWS, _BLOCK_BYTES // (K * K * 4)))
 
 #: pivot-block width: rank-P updates run on the MXU; P=8 keeps the
 #: in-VMEM pivot-block inversion tiny while giving the MXU real work.
@@ -122,16 +139,19 @@ def _gj_kernel(A_ref, b_ref, x_ref, *, pivot_block: int):
 def gj_solve_pallas(
     A: jax.Array,  # [B, K, K]
     b: jax.Array,  # [B, K]
-    block_rows: int = _BLOCK_ROWS,
+    block_rows: int | None = None,
     pivot_block: int = _PIVOT_BLOCK,
     interpret: bool = False,
 ) -> jax.Array:
     """Batched SPD solve, blocked Gauss-Jordan in VMEM. B is padded to a
-    multiple of ``block_rows`` with identity systems (padding solves to
-    0); K must be a multiple of ``pivot_block``."""
+    multiple of ``block_rows`` (default: auto-sized to the VMEM budget
+    for this K); padding rows are identity systems (solve to 0); K must
+    be a multiple of ``pivot_block``."""
     B, K = b.shape
     if K % pivot_block:
         raise ValueError(f"K={K} must be a multiple of pivot_block={pivot_block}")
+    if block_rows is None:
+        block_rows = _auto_block_rows(K)
     n_pad = -(-B // block_rows) * block_rows - B
     if n_pad:
         eye = jnp.broadcast_to(jnp.eye(K, dtype=A.dtype), (n_pad, K, K))
@@ -161,11 +181,12 @@ def spd_solve(A: jax.Array, b: jax.Array, method: str = "cholesky") -> jax.Array
     "pallas_interpret" runs the same kernel logic on CPU for tests;
     "cholesky" is the portable XLA path. K not divisible by the pivot
     block falls back to Cholesky (rank is usually a multiple of 8 —
-    ``ALSConfig.rank_pad_multiple`` exists to make it one).
+    ``ALSConfig.rank_pad_multiple`` exists to make it one), as does
+    K > 512 where even a one-row block would crowd VMEM.
     """
     if method in ("pallas", "pallas_interpret"):
         K = A.shape[-1]
-        if K % _PIVOT_BLOCK == 0:
+        if K % _PIVOT_BLOCK == 0 and K <= _MAX_PALLAS_K:
             A2 = A.reshape((-1, K, K))
             b2 = b.reshape((-1, K))
             x = gj_solve_pallas(A2, b2, interpret=(method == "pallas_interpret"))

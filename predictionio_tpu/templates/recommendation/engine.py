@@ -144,8 +144,11 @@ class RecommendationDataSource(DataSource):
                 rating = float(e.properties.get_as("rating", float))
             key = (e.entity_id, e.target_entity_id)
             prev = ratings.get(key)
-            # latest event per (user, item) wins
-            if prev is None or e.event_time >= prev[0]:
+            # latest event per (user, item) wins; equal timestamps break
+            # toward the higher rating — an order-independent rule, so
+            # single-host and multi-host reads agree (the multi-host merge
+            # below folds the same (event_time, rating) max)
+            if prev is None or (e.event_time, rating) >= prev:
                 ratings[key] = (e.event_time, rating)
         if ctx.num_hosts > 1:
             # cross-host coherence (round-1 advisor high finding): events of
