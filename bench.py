@@ -117,7 +117,10 @@ def _time_training(rows, cols, vals, num_users, num_items, rank, iters, reg=0.05
         "padded_tflops_per_sec": round(
             flops * (padded / (2 * nnz)) / per_sweep / 1e12, 2
         ),
-        "hot_rows": int(user_b.hot_rows.shape[0] + item_b.hot_rows.shape[0] - 2),
+        "hot_rows": int(
+            sum(hr.shape[0] - 1 for hr in user_b.hot_rows)
+            + sum(hr.shape[0] - 1 for hr in item_b.hot_rows)
+        ),
     }
     return nnz * iters / dt, detail
 
@@ -147,19 +150,18 @@ def _cpu_als_sweep(user_b, item_b, uf, vf, rank, reg=0.05):
                 A, b, n = gram(other, ch, c)
                 A += (reg * np.maximum(n, 1.0))[:, None, None] * eye
                 factors[ch.row_id[c]] = np.linalg.solve(A, b[..., None])[..., 0]  # batched LAPACK
-        if bucketed.hot:
-            num_slots = bucketed.hot_rows.shape[0]
+        for ch, hot_rows_g in zip(bucketed.hot, bucketed.hot_rows):
+            num_slots = hot_rows_g.shape[0]
             A_acc = np.zeros((num_slots, rank, rank), np.float32)
             b_acc = np.zeros((num_slots, rank), np.float32)
             n_acc = np.zeros(num_slots, np.float32)
-            for ch in bucketed.hot:
-                for c in range(ch.row_id.shape[0]):
-                    A, b, n = gram(other, ch, c)
-                    np.add.at(A_acc, ch.row_id[c], A)
-                    np.add.at(b_acc, ch.row_id[c], b)
-                    np.add.at(n_acc, ch.row_id[c], n)
+            for c in range(ch.row_id.shape[0]):
+                A, b, n = gram(other, ch, c)
+                np.add.at(A_acc, ch.row_id[c], A)
+                np.add.at(b_acc, ch.row_id[c], b)
+                np.add.at(n_acc, ch.row_id[c], n)
             A_acc += (reg * np.maximum(n_acc, 1.0))[:, None, None] * eye
-            factors[np.asarray(bucketed.hot_rows)] = np.linalg.solve(A_acc, b_acc[..., None])[..., 0]
+            factors[np.asarray(hot_rows_g)] = np.linalg.solve(A_acc, b_acc[..., None])[..., 0]
         factors[-1] = 0.0
         return factors
 

@@ -26,9 +26,16 @@ Memory-bounded solver design (v2):
 * **Mesh sharding** — bucket rows/segments are sharded over the ``data``
   axis; the persistent factor tables are sharded over the ``model`` axis
   (ALX-style — NOT replicated, so catalog size scales with the mesh).
-  Each half-sweep all-gathers the opposite table once (O(N·K), small
-  next to the ratings), computes the implicit Gramian with a psum over
-  ``model``, and scatters solved rows back to their ``model`` shard.
+  The opposite table never materializes replicated: under ``shard_map``
+  each device gathers only from its LOCAL table shard (out-of-shard
+  entries masked to zero) and the partial Gramians ``[C,K,K]`` are
+  psum'd over ``model`` — the small normal-equation blocks move over
+  ICI instead of the catalog-sized table, so peak per-device HBM is
+  O(catalog / model_axis) + O(chunk). Solved rows scatter back to
+  their ``model`` shard (GSPMD emits the exchange).
+* **Hot-slot grouping** — the hot-row Gramian accumulator is built per
+  group of at most ``hot_group_slots`` rows, so its ``[H,K,K]`` buffer
+  is bounded by a config knob instead of growing with nnz/max_width.
 
 Supports MLlib's two objectives:
 
@@ -99,6 +106,10 @@ class ALSConfig:
     bucket_widths: tuple = _DEFAULT_BUCKET_WIDTHS
     #: max padded entries per scan chunk — the HBM knob
     chunk_entries: int = _DEFAULT_CHUNK_ENTRIES
+    #: max hot rows per Gramian-accumulator group: bounds the [H,K,K]
+    #: hot accumulator at hot_group_slots·K² floats per group (extra
+    #: groups only cost one more batched solve + scatter each)
+    hot_group_slots: int = 2048
     #: matmul precision for the normal equations: "highest" (full f32,
     #: MLlib-parity accuracy), "high", or "default" (bf16 passes, fastest)
     precision: str = "highest"
@@ -136,11 +147,16 @@ class BucketedRatings(NamedTuple):
     ``hot``, ``hot_rows``) are children; the int metadata travels in the
     treedef so it stays STATIC under jit (a multi-process jit must not
     receive per-host scalar leaves, and the sentinel row index wants to
-    be a compile-time constant)."""
+    be a compile-time constant).
+
+    Hot rows are split into GROUPS of at most ``hot_group_slots`` rows:
+    ``hot[g]`` holds group g's segments with group-local slot ids and
+    ``hot_rows[g]`` maps those slots back to row ids — so the sweep's
+    Gramian accumulator is [H_g, K, K], never [num_hot, K, K]."""
 
     normal: tuple  # tuple[_Chunked, ...] — rows fitting one segment
-    hot: tuple  # tuple[_Chunked, ...] — segments of hot rows (row_id = slot)
-    hot_rows: Any  # [num_hot + 1] int32 — slot -> row id; last = sentinel
+    hot: tuple  # tuple[_Chunked, ...] — one per group (row_id = local slot)
+    hot_rows: tuple  # tuple of [H_g + 1] int32 — slot -> row id; last = sentinel
     num_rows: int
     num_cols: int
     nnz: int  # real entries
@@ -285,6 +301,7 @@ def build_buckets(
     widths: Sequence[int] = _DEFAULT_BUCKET_WIDTHS,
     row_multiple: int = 8,
     chunk_entries: int = _DEFAULT_CHUNK_ENTRIES,
+    hot_group_slots: int = 2048,
 ) -> BucketedRatings:
     """Host-side: COO ratings -> chunked, segmented, padded buckets.
 
@@ -323,18 +340,27 @@ def build_buckets(
         normal_chunks.append(pack(seg_row, seg_start, seg_len, w, num_rows))
 
     num_hot = int(seg.hot_rows.size)
+    hot_rows_groups: list = []
     if num_hot:
-        hot_chunks.append(
-            pack(seg.hot_slot, seg.hot_start, seg.hot_len, seg.w_max, num_hot)
-        )
-    hot_rows = np.full(num_hot + 1, num_rows, dtype=np.int32)
-    if num_hot:
-        hot_rows[:num_hot] = seg.hot_rows
+        n_groups = -(-num_hot // hot_group_slots)
+        g_of_seg = seg.hot_slot // hot_group_slots
+        for g in range(n_groups):
+            sel = g_of_seg == g
+            h_g = min(hot_group_slots, num_hot - g * hot_group_slots)
+            hot_chunks.append(
+                pack(
+                    (seg.hot_slot[sel] - g * hot_group_slots).astype(np.int32),
+                    seg.hot_start[sel], seg.hot_len[sel], seg.w_max, h_g,
+                )
+            )
+            hr = np.full(h_g + 1, num_rows, dtype=np.int32)
+            hr[:h_g] = seg.hot_rows[g * hot_group_slots : g * hot_group_slots + h_g]
+            hot_rows_groups.append(hr)
 
     return BucketedRatings(
         tuple(normal_chunks),
         tuple(hot_chunks),
-        hot_rows,
+        tuple(hot_rows_groups),
         num_rows,
         num_cols,
         nnz,
@@ -349,8 +375,8 @@ def rated_row_mask(b: BucketedRatings) -> np.ndarray:
     mask = np.zeros(b.num_rows + 1, dtype=bool)
     for ch in b.normal:
         mask[np.asarray(ch.row_id).ravel()] = True
-    hr = np.asarray(b.hot_rows)
-    mask[hr] = True
+    for hr in b.hot_rows:
+        mask[np.asarray(hr)] = True
     mask[b.num_rows] = False
     return mask[: b.num_rows]
 
@@ -360,8 +386,31 @@ def rated_row_mask(b: BucketedRatings) -> np.ndarray:
 # ---------------------------------------------------------------------------
 
 
+def _partials(
+    Q: jax.Array,  # [C, L, K] masked gathered factors
+    chunk_val: jax.Array,  # [C, L]
+    meff: jax.Array,  # [C, L] effective mask (0 where padded / out of shard)
+    implicit: bool,
+    alpha: float,
+    hi: jax.lax.Precision,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Per-chunk partial normal equations (no λ/YᵀY yet). All heavy ops
+    are [C,L,K]-shaped einsums -> MXU."""
+    if implicit:
+        conf_minus_1 = alpha * jnp.abs(chunk_val) * meff  # c - 1
+        pref = (chunk_val > 0).astype(Q.dtype) * meff
+        A = jnp.einsum("clk,cl,clj->ckj", Q, conf_minus_1, Q, precision=hi)
+        b = jnp.einsum("clk,cl->ck", Q, (1.0 + conf_minus_1) * pref, precision=hi)
+        n = pref.sum(axis=-1)  # MLlib numExplicits: positive ratings
+    else:
+        A = jnp.einsum("clk,clj->ckj", Q, Q, precision=hi)
+        b = jnp.einsum("clk,cl->ck", Q, chunk_val * meff, precision=hi)
+        n = meff.sum(axis=-1)
+    return A, b, n
+
+
 def _gram_chunk(
-    other: jax.Array,  # [num_cols+1, K] — replicated working copy
+    other: jax.Array,  # [num_cols+1(+pad), K] — model-sharded on a 2-axis mesh
     chunk_idx: jax.Array,  # [C, L]
     chunk_val: jax.Array,  # [C, L]
     chunk_mask: jax.Array,  # [C, L]
@@ -370,34 +419,65 @@ def _gram_chunk(
     hi: jax.lax.Precision,
     mesh: Mesh | None,
     data_axis: str | None,
+    model_axis: str | None,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Partial normal equations for one chunk of segments.
 
     Returns (A [C,K,K], b [C,K], n [C]) WITHOUT the λ/YᵀY terms, so the
     same kernel serves both the in-chunk solve (normal rows) and the
-    Gramian accumulation (hot-row segments). All heavy ops are
-    [C,L,K]-shaped einsums -> MXU.
+    Gramian accumulation (hot-row segments).
+
+    With a model axis the opposite table stays SHARDED: under shard_map
+    each device gathers only from its local [N/S, K] shard (entries
+    owned by other shards masked to zero) and the partial Gramians are
+    psum'd over ``model``. The catalog-sized table never moves or
+    replicates — only O(C·K²) Gramian blocks cross ICI (VERDICT r2
+    item 1; the chunk-Gramians-move-not-the-table half of the ALX
+    recipe, PAPERS.md).
     """
+    if mesh is not None and model_axis is not None:
+        S = int(mesh.shape[model_axis])
+        rps = other.shape[0] // S  # train_als pads the table to a multiple
+
+        def local(tbl, idx, val, mask):
+            me = jax.lax.axis_index(model_axis)
+            lidx = idx - me * rps
+            inr = (lidx >= 0) & (lidx < rps)
+            meff = mask * inr.astype(mask.dtype)
+            Q = tbl[jnp.where(inr, lidx, 0)] * meff[..., None]
+            A, b, n = _partials(Q, val, meff, implicit, alpha, hi)
+            return (
+                jax.lax.psum(A, model_axis),
+                jax.lax.psum(b, model_axis),
+                jax.lax.psum(n, model_axis),
+            )
+
+        return jax.shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(
+                PartitionSpec(model_axis, None),
+                PartitionSpec(data_axis, None),
+                PartitionSpec(data_axis, None),
+                PartitionSpec(data_axis, None),
+            ),
+            out_specs=(
+                PartitionSpec(data_axis, None, None),
+                PartitionSpec(data_axis, None),
+                PartitionSpec(data_axis),
+            ),
+        )(other, chunk_idx, chunk_val, chunk_mask)
+
     if mesh is not None:
-        # replicated table, segment-sharded indices -> segment-sharded
-        # gather (each device touches only its rows — the ALX gather step)
+        # data-parallel mesh (tables replicated by construction):
+        # segment-sharded gather — each device touches only its rows
         gathered = other.at[chunk_idx].get(
             out_sharding=NamedSharding(mesh, PartitionSpec(data_axis, None, None))
         )
     else:
         gathered = other[chunk_idx]
     Q = gathered * chunk_mask[..., None]  # [C, L, K]
-    if implicit:
-        conf_minus_1 = alpha * jnp.abs(chunk_val) * chunk_mask  # c - 1
-        pref = (chunk_val > 0).astype(Q.dtype) * chunk_mask
-        A = jnp.einsum("clk,cl,clj->ckj", Q, conf_minus_1, Q, precision=hi)
-        b = jnp.einsum("clk,cl->ck", Q, (1.0 + conf_minus_1) * pref, precision=hi)
-        n = pref.sum(axis=-1)  # MLlib numExplicits: positive ratings
-    else:
-        A = jnp.einsum("clk,clj->ckj", Q, Q, precision=hi)
-        b = jnp.einsum("clk,cl->ck", Q, chunk_val * chunk_mask, precision=hi)
-        n = chunk_mask.sum(axis=-1)
-    return A, b, n
+    return _partials(Q, chunk_val, chunk_mask, implicit, alpha, hi)
 
 
 def _finish_solve(
@@ -440,15 +520,10 @@ def _half_sweep(
         # the pure-data-parallel layout of e.g. `pio train --mesh data=8`
         spec = PartitionSpec(model_axis, None) if model_axis else PartitionSpec(None, None)
         model_sharding = NamedSharding(mesh, spec)
-        # One explicit all-gather of the opposite table per half-sweep
-        # (O(N·K) over ICI — small next to the ratings). Gathers below are
-        # then device-local. ALX gathers shard-chunks instead; at
-        # PredictionIO catalog scales the one-shot gather is cheaper.
-        other = jax.lax.with_sharding_constraint(
-            other_factors, NamedSharding(mesh, PartitionSpec(None, None))
-        )
-    else:
-        other = other_factors
+    # The opposite table is consumed AS SHARDED: _gram_chunk's shard-map
+    # path gathers from each device's local shard and psums the Gramians,
+    # so the full table never materializes replicated (VERDICT r2 item 1).
+    other = other_factors
 
     yty = None
     if implicit:
@@ -468,7 +543,10 @@ def _half_sweep(
 
         def step(fac, xs):
             row_id, idx, val, mask = xs
-            A, b, n = _gram_chunk(other, idx, val, mask, implicit, alpha, hi, mesh, data_axis)
+            A, b, n = _gram_chunk(
+                other, idx, val, mask, implicit, alpha, hi,
+                mesh, data_axis, model_axis,
+            )
             x = _finish_solve(A, b, n, reg, yty, solver)  # [C, K]
             if model_sharding is not None:
                 # scatter data-sharded solved rows to their model shard —
@@ -481,11 +559,13 @@ def _half_sweep(
 
         factors, _ = jax.lax.scan(step, factors, tuple(ch))
 
-    # --- hot rows: accumulate Gramians across segments, solve once -------
-    if bucketed.hot:
-        num_slots = int(bucketed.hot_rows.shape[0])  # num_hot + sentinel
-        K = factors.shape[-1]
-        replicated = None if mesh is None else NamedSharding(mesh, PartitionSpec())
+    # --- hot rows: accumulate Gramians across segments, solve per group --
+    # groups of <= hot_group_slots rows bound the accumulator at
+    # [H_g, K, K] regardless of how many rows are hot (VERDICT r2 weak #2)
+    K = factors.shape[-1]
+    replicated = None if mesh is None else NamedSharding(mesh, PartitionSpec())
+    for ch, hot_rows_g in zip(bucketed.hot, bucketed.hot_rows):
+        num_slots = int(hot_rows_g.shape[0])  # H_g + sentinel
         acc = (
             jnp.zeros((num_slots, K, K), factors.dtype, device=replicated),
             jnp.zeros((num_slots, K), factors.dtype, device=replicated),
@@ -495,11 +575,14 @@ def _half_sweep(
         def hot_step(carry, xs):
             A_acc, b_acc, n_acc = carry
             slot, idx, val, mask = xs
-            A, b, n = _gram_chunk(other, idx, val, mask, implicit, alpha, hi, mesh, data_axis)
+            A, b, n = _gram_chunk(
+                other, idx, val, mask, implicit, alpha, hi,
+                mesh, data_axis, model_axis,
+            )
             # scatter-add partial Gramians: segments of one row combine
             # here — the hot-row splitting that bounds memory by
             # nnz/max_width instead of the hottest row's count. The
-            # accumulators are replicated (H is small by construction), so
+            # accumulators are replicated (H_g is config-bounded), so
             # on a mesh the adds psum across the data axis.
             if replicated is not None:
                 A_acc = A_acc.at[slot].add(A, out_sharding=replicated)
@@ -511,15 +594,13 @@ def _half_sweep(
                 n_acc = n_acc.at[slot].add(n)
             return (A_acc, b_acc, n_acc), None
 
-        # accumulate across ALL hot buckets before the one solve+scatter
-        for ch in bucketed.hot:
-            acc, _ = jax.lax.scan(hot_step, acc, tuple(ch))
+        acc, _ = jax.lax.scan(hot_step, acc, tuple(ch))
         x_hot = _finish_solve(*acc, reg, yty, solver)  # [num_slots, K]
-        hot_rows = jnp.asarray(bucketed.hot_rows)
+        hr = jnp.asarray(hot_rows_g)
         if model_sharding is not None:
-            factors = factors.at[hot_rows].set(x_hot, out_sharding=model_sharding)
+            factors = factors.at[hr].set(x_hot, out_sharding=model_sharding)
         else:
-            factors = factors.at[hot_rows].set(x_hot)
+            factors = factors.at[hr].set(x_hot)
 
     # padding rows scattered into the sentinel; re-zero it (array index:
     # the scalar-index path rejects/breaks on out_sharding). The sentinel
@@ -595,7 +676,7 @@ def _device_buckets(
     return BucketedRatings(
         tuple(put(ch) for ch in b.normal),
         tuple(put(ch) for ch in b.hot),
-        np.asarray(b.hot_rows),
+        tuple(np.asarray(hr) for hr in b.hot_rows),
         b.num_rows,
         b.num_cols,
         b.nnz,
@@ -613,6 +694,7 @@ def _multihost_bucketed(
     data_axis: str,
     widths: Sequence[int],
     chunk_entries: int,
+    hot_group_slots: int = 2048,
 ) -> tuple[BucketedRatings, np.ndarray]:
     """Multi-host: per-host COO shards -> GLOBAL sharded bucket arrays
     without ever materializing the global rating set on one host
@@ -669,7 +751,6 @@ def _multihost_bucketed(
     # --- agree on per-width shapes (tiny metadata gather) -----------------
     local_meta = {
         "widths": {w: int(seg.per_width[w][0].size) for w in seg.per_width},
-        "hot_segs": int(seg.hot_slot.size),
         "num_hot": int(seg.hot_rows.size),
         "nnz": int(rows.size),
     }
@@ -732,33 +813,50 @@ def _multihost_bucketed(
         )
 
     hot_chunks = []
+    hot_rows_groups = []
     if num_hot_tot:
-        n_seg_max = max(mt["hot_segs"] for mt in metas)
-        # local slots shift to the global slot space; padding segments hit
-        # the global sentinel slot num_hot_tot
-        hot_chunks.append(
-            assemble(
-                seg.hot_slot + hot_offset, seg.hot_start, seg.hot_len,
-                seg.w_max, num_hot_tot, n_seg_max,
-            )
-        )
-    hot_rows = np.full(num_hot_tot + 1, num_rows, dtype=np.int32)
-    if num_hot_tot:
+        # groups of <= hot_group_slots GLOBAL slots bound the sweep's
+        # Gramian accumulator; every host packs a (possibly empty) block
+        # for every group so global shapes agree
+        H = hot_group_slots
+        n_groups = -(-num_hot_tot // H)
+        g_slot = (seg.hot_slot.astype(np.int64) + hot_offset).astype(np.int64)
+        my_counts = [
+            int(np.count_nonzero((g_slot >= g * H) & (g_slot < (g + 1) * H)))
+            for g in range(n_groups)
+        ]
+        all_counts = allgather_objects(my_counts)
         gathered_hot = allgather_objects(seg.hot_rows.tolist())
-        hot_rows[:num_hot_tot] = np.concatenate(
+        hot_rows_all = np.concatenate(
             [np.asarray(h, np.int32) for h in gathered_hot]
         )
-    # a raw numpy leaf must not enter a multi-process jit — materialize the
-    # (identical-everywhere) slot map as a replicated global array
-    hot_rows_dev = jax.make_array_from_callback(
-        hot_rows.shape, NamedSharding(mesh, PartitionSpec(None)),
-        lambda idx: hot_rows[idx],
-    )
+        rep_sharding = NamedSharding(mesh, PartitionSpec(None))
+        for g in range(n_groups):
+            sel = (g_slot >= g * H) & (g_slot < (g + 1) * H)
+            h_g = min(H, num_hot_tot - g * H)
+            n_seg_max = max(c[g] for c in all_counts)
+            hot_chunks.append(
+                assemble(
+                    (g_slot[sel] - g * H).astype(np.int32),
+                    seg.hot_start[sel], seg.hot_len[sel],
+                    seg.w_max, h_g, n_seg_max,
+                )
+            )
+            hr = np.full(h_g + 1, num_rows, dtype=np.int32)
+            hr[:h_g] = hot_rows_all[g * H : g * H + h_g]
+            # a raw numpy leaf must not enter a multi-process jit —
+            # materialize the (identical-everywhere) slot map replicated
+            hot_rows_groups.append(
+                jax.make_array_from_callback(
+                    hr.shape, rep_sharding,
+                    lambda idx, hr=hr: hr[idx],
+                )
+            )
 
     bucketed = BucketedRatings(
         tuple(normal_chunks),
         tuple(hot_chunks),
-        hot_rows_dev,
+        tuple(hot_rows_groups),
         num_rows,
         num_cols,
         nnz_global,
@@ -860,11 +958,11 @@ def train_als(
 
         user_bucketed, u_rated = _multihost_bucketed(
             rows, cols, vals, num_users, num_items, mesh, data_axis,
-            config.bucket_widths, config.chunk_entries,
+            config.bucket_widths, config.chunk_entries, config.hot_group_slots,
         )
         item_bucketed, i_rated = _multihost_bucketed(
             cols, rows, vals, num_items, num_users, mesh, data_axis,
-            config.bucket_widths, config.chunk_entries,
+            config.bucket_widths, config.chunk_entries, config.hot_group_slots,
         )
         # the global rated mask is the OR of the per-host masks
         u_rated = np.bitwise_or.reduce(allgather_objects(np.packbits(u_rated)))
@@ -885,11 +983,13 @@ def train_als(
             rows, cols, vals, num_users, num_items,
             widths=config.bucket_widths, row_multiple=row_multiple,
             chunk_entries=config.chunk_entries,
+            hot_group_slots=config.hot_group_slots,
         )
         item_b = build_buckets(
             cols, rows, vals, num_items, num_users,
             widths=config.bucket_widths, row_multiple=row_multiple,
             chunk_entries=config.chunk_entries,
+            hot_group_slots=config.hot_group_slots,
         )
         u_rated = rated_row_mask(user_b)
         i_rated = rated_row_mask(item_b)
@@ -936,6 +1036,22 @@ def train_als(
             uf = jax.device_put(uf, model_sharded)
             vf = jax.device_put(vf, model_sharded)
 
+    rep = None if mesh is None else NamedSharding(mesh, PartitionSpec())
+    if mesh is not None:
+
+        def _strip(a, b):
+            # replicate BEFORE slicing: the canonical length need not
+            # divide the model axis, so a sharded-dim slice is illegal
+            # (reshard, not with_sharding_constraint — the latter doesn't
+            # change the sharded *type* under explicit-sharding meshes)
+            a = jax.sharding.reshard(a, rep)
+            b = jax.sharding.reshard(b, rep)
+            return a[: num_users + 1], b[: num_items + 1]
+
+        # jitted ONCE per train: the jit cache is keyed on the function
+        # object, so a per-save closure would retrace every checkpoint
+        _strip_jit = jax.jit(_strip, out_shardings=rep)
+
     def _to_canonical(u: jax.Array, v: jax.Array) -> dict:
         """Checkpoint state at the canonical (num_rows+1, K) replicated
         shape: the on-disk layout must not depend on the mesh's model-axis
@@ -945,19 +1061,19 @@ def train_als(
         sweep, whose donation would otherwise race the live tables."""
         if mesh is None:
             return {"user": jnp.copy(u), "item": jnp.copy(v)}
-        rep = NamedSharding(mesh, PartitionSpec())
-
-        def strip(a, b):
-            # replicate BEFORE slicing: the canonical length need not
-            # divide the model axis, so a sharded-dim slice is illegal
-            # (reshard, not with_sharding_constraint — the latter doesn't
-            # change the sharded *type* under explicit-sharding meshes)
-            a = jax.sharding.reshard(a, rep)
-            b = jax.sharding.reshard(b, rep)
-            return a[: num_users + 1], b[: num_items + 1]
-
-        cu, ci = jax.jit(strip, out_shardings=rep)(u, v)
+        cu, ci = _strip_jit(u, v)
         return {"user": cu, "item": ci}
+
+    def _canonical_like() -> dict:
+        """Abstract restore template — no device work, just shapes."""
+        return {
+            "user": jax.ShapeDtypeStruct(
+                (num_users + 1, rank), jnp.float32, sharding=rep
+            ),
+            "item": jax.ShapeDtypeStruct(
+                (num_items + 1, rank), jnp.float32, sharding=rep
+            ),
+        }
 
     def _from_canonical(state: dict) -> tuple[jax.Array, jax.Array]:
         """Re-pad restored canonical factors to this mesh's table shape
@@ -981,9 +1097,8 @@ def train_als(
         manager = CheckpointManager(config.checkpoint_dir)
         latest = manager.latest_step()
         if latest is not None:
-            like = _to_canonical(uf, vf)
             try:
-                state = manager.restore(latest, like=like)
+                state = manager.restore(latest, like=_canonical_like())
             except (ValueError, TypeError, KeyError) as exc:
                 # shape/structure drift only (e.g. a pre-canonical padded
                 # checkpoint, or a different rank); transient I/O errors
