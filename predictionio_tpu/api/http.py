@@ -11,14 +11,47 @@ from __future__ import annotations
 
 import json
 import logging
+import os
+import ssl
 import threading
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Mapping
 
-__all__ = ["serve", "start_background"]
+__all__ = [
+    "serve",
+    "start_background",
+    "make_ssl_context",
+    "ssl_context_from_env",
+]
 
 logger = logging.getLogger(__name__)
+
+
+def make_ssl_context(
+    cert_path: str, key_path: str, key_password: str | None = None
+) -> ssl.SSLContext:
+    """Server-side TLS context from a PEM cert/key pair.
+
+    Parity: ``common/.../configuration/SSLConfiguration.scala`` — the
+    reference reads a JKS keystore via typesafe-config and hands an
+    ``SSLContext`` to both spray servers; here the PEM pair comes from
+    CLI flags or ``PIO_SSL_CERT``/``PIO_SSL_KEY`` env vars and wraps the
+    listening socket of any framework server."""
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(cert_path, key_path, password=key_password)
+    return ctx
+
+
+def ssl_context_from_env() -> ssl.SSLContext | None:
+    """TLS context from ``PIO_SSL_CERT``/``PIO_SSL_KEY`` (+ optional
+    ``PIO_SSL_KEY_PASSWORD``), or None when unset — the deployment-env
+    layer of the config triad (SURVEY.md section 6.6)."""
+    cert = os.environ.get("PIO_SSL_CERT")
+    key = os.environ.get("PIO_SSL_KEY")
+    if not cert or not key:
+        return None
+    return make_ssl_context(cert, key, os.environ.get("PIO_SSL_KEY_PASSWORD"))
 
 #: signature shared with EventService.dispatch / QueryService.dispatch
 Dispatcher = Callable[..., "object"]
@@ -93,10 +126,36 @@ def _make_handler(dispatch: Dispatcher):
     return Handler
 
 
-def serve(dispatch: Dispatcher, host: str = "0.0.0.0", port: int = 7070) -> None:
-    """Blocking serve-forever (used by ``pio eventserver`` / ``pio deploy``)."""
+def _make_server(
+    dispatch: Dispatcher,
+    host: str,
+    port: int,
+    ssl_context: ssl.SSLContext | None,
+) -> ThreadingHTTPServer:
     server = ThreadingHTTPServer((host, port), _make_handler(dispatch))
-    logger.info("Listening on %s:%d", host, port)
+    if ssl_context is not None:
+        server.socket = ssl_context.wrap_socket(server.socket, server_side=True)
+    return server
+
+
+def serve(
+    dispatch: Dispatcher,
+    host: str = "0.0.0.0",
+    port: int = 7070,
+    ssl_context: ssl.SSLContext | None = None,
+    ready_callback: Callable[[ThreadingHTTPServer], None] | None = None,
+) -> None:
+    """Blocking serve-forever (used by ``pio eventserver`` / ``pio deploy``).
+
+    ``ready_callback`` receives the bound server before requests flow —
+    deploy uses it to wire the ``GET /stop`` shutdown hook."""
+    server = _make_server(dispatch, host, port, ssl_context)
+    logger.info(
+        "Listening on %s://%s:%d",
+        "https" if ssl_context else "http", host, port,
+    )
+    if ready_callback is not None:
+        ready_callback(server)
     try:
         server.serve_forever()
     finally:
@@ -104,12 +163,15 @@ def serve(dispatch: Dispatcher, host: str = "0.0.0.0", port: int = 7070) -> None
 
 
 def start_background(
-    dispatch: Dispatcher, host: str = "127.0.0.1", port: int = 0
+    dispatch: Dispatcher,
+    host: str = "127.0.0.1",
+    port: int = 0,
+    ssl_context: ssl.SSLContext | None = None,
 ) -> tuple[ThreadingHTTPServer, threading.Thread]:
     """Start on a daemon thread; returns (server, thread). ``port=0`` picks
     a free port (``server.server_address[1]``). Used by tests and the
     feedback loop."""
-    server = ThreadingHTTPServer((host, port), _make_handler(dispatch))
+    server = _make_server(dispatch, host, port, ssl_context)
     thread = threading.Thread(target=server.serve_forever, daemon=True)
     thread.start()
     return server, thread

@@ -295,3 +295,43 @@ def status_check(out: Out = _print) -> dict:
     out("(sanity check) All systems go!" if ok else "Storage check FAILED")
     results["ok"] = ok
     return results
+
+
+def undeploy(
+    ip: str = "127.0.0.1",
+    port: int = 8000,
+    https: bool = False,
+    insecure: bool = False,
+    out: Out = _print,
+) -> None:
+    """``pio undeploy`` — ask a deployed query server to shut down via its
+    ``GET /stop`` route (parity: Console's undeploy hitting CreateServer's
+    stop endpoint). ``insecure`` skips TLS verification (self-signed
+    deployments)."""
+    import ssl as _ssl
+    import urllib.error
+    import urllib.request
+
+    scheme = "https" if https else "http"
+    url = f"{scheme}://{ip}:{port}/stop"
+    ctx = None
+    if https:
+        ctx = _ssl.create_default_context()
+        if insecure:
+            ctx.check_hostname = False
+            ctx.verify_mode = _ssl.CERT_NONE
+    try:
+        with urllib.request.urlopen(url, timeout=10, context=ctx) as resp:
+            resp.read()
+    except urllib.error.HTTPError as e:
+        # the server is UP but refused — report its actual answer, not a
+        # bogus "unreachable" (501 = deployment without a stop hook)
+        raise RuntimeError(
+            f"Deployment at {ip}:{port} refused to stop: "
+            f"HTTP {e.code} {e.reason}"
+        ) from e
+    except urllib.error.URLError as e:
+        raise RuntimeError(
+            f"Could not reach a deployment at {url}: {e.reason}"
+        ) from e
+    out(f"Undeployed engine server at {ip}:{port}.")

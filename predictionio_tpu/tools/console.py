@@ -96,6 +96,16 @@ def build_parser() -> argparse.ArgumentParser:
         "'data=N,model=M' axis sizes",
     )
 
+    def add_ssl_flags(sp):
+        sp.add_argument(
+            "--cert", default=None,
+            help="PEM certificate for https (default: $PIO_SSL_CERT)",
+        )
+        sp.add_argument(
+            "--key", default=None,
+            help="PEM private key for https (default: $PIO_SSL_KEY)",
+        )
+
     # ---- deploy
     deploy = sub.add_parser("deploy", help="serve the latest trained instance")
     deploy.add_argument("--engine-json", default="engine.json")
@@ -106,6 +116,19 @@ def build_parser() -> argparse.ArgumentParser:
     deploy.add_argument("--event-server-ip", default="127.0.0.1")
     deploy.add_argument("--event-server-port", type=int, default=7070)
     deploy.add_argument("--accesskey", default="")
+    add_ssl_flags(deploy)
+
+    # ---- undeploy
+    und = sub.add_parser(
+        "undeploy", help="stop a deployed engine server via GET /stop"
+    )
+    und.add_argument("--ip", default="127.0.0.1")
+    und.add_argument("--port", type=int, default=8000)
+    und.add_argument("--https", action="store_true")
+    und.add_argument(
+        "--insecure", action="store_true",
+        help="skip TLS certificate verification (self-signed deployments)",
+    )
 
     # ---- eval
     ev = sub.add_parser("eval", help="run an evaluation sweep")
@@ -124,16 +147,19 @@ def build_parser() -> argparse.ArgumentParser:
     es.add_argument("--ip", default="0.0.0.0")
     es.add_argument("--port", type=int, default=7070)
     es.add_argument("--stats", action="store_true")
+    add_ssl_flags(es)
 
     # ---- dashboard
     db = sub.add_parser("dashboard", help="start the evaluation dashboard")
     db.add_argument("--ip", default="127.0.0.1")
     db.add_argument("--port", type=int, default=9000)
+    add_ssl_flags(db)
 
     # ---- adminserver
     adm = sub.add_parser("adminserver", help="start the admin REST server")
     adm.add_argument("--ip", default="127.0.0.1")
     adm.add_argument("--port", type=int, default=7071)
+    add_ssl_flags(adm)
 
     # ---- batchpredict
     bp = sub.add_parser("batchpredict", help="bulk predictions from a query file")
@@ -163,6 +189,22 @@ def _parse_mesh(spec: str):
     return mesh_context(
         axis_sizes=list(sizes.values()), axis_names=list(sizes.keys())
     )
+
+
+def _ssl_from_args(args):
+    """TLS context from --cert/--key flags, falling back to the
+    PIO_SSL_CERT / PIO_SSL_KEY env vars; None = plain http. A
+    half-specified pair is an error — silently starting plain HTTP when
+    the operator passed --cert would leak traffic they meant to encrypt."""
+    from predictionio_tpu.api.http import make_ssl_context, ssl_context_from_env
+
+    cert = getattr(args, "cert", None)
+    key = getattr(args, "key", None)
+    if bool(cert) != bool(key):
+        raise ValueError("--cert and --key must be given together")
+    if cert and key:
+        return make_ssl_context(cert, key)
+    return ssl_context_from_env()
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -240,8 +282,23 @@ def main(argv: list[str] | None = None) -> int:
             service = QueryService(
                 variant, feedback=feedback, instance_id=args.engine_instance_id
             )
+
+            def wire_stop(server):
+                # GET /stop answers first, then the server shuts down on a
+                # helper thread (shutdown() from a handler would deadlock)
+                import threading
+
+                service.stop_server = lambda: threading.Thread(
+                    target=server.shutdown, daemon=True
+                ).start()
+
             print(f"Engine is deployed and running. Listening on {args.ip}:{args.port}")
-            serve(service.dispatch, args.ip, args.port)
+            serve(
+                service.dispatch, args.ip, args.port,
+                ssl_context=_ssl_from_args(args), ready_callback=wire_stop,
+            )
+        elif cmd == "undeploy":
+            commands.undeploy(args.ip, args.port, args.https, args.insecure)
         elif cmd == "eval":
             from predictionio_tpu.controller import local_context
             from predictionio_tpu.controller.evaluation import EngineParamsGenerator
@@ -277,19 +334,25 @@ def main(argv: list[str] | None = None) -> int:
 
             service = EventService(stats=args.stats)
             print(f"Event Server is listening on {args.ip}:{args.port}")
-            serve(service.dispatch, args.ip, args.port)
+            serve(service.dispatch, args.ip, args.port, ssl_context=_ssl_from_args(args))
         elif cmd == "dashboard":
             from predictionio_tpu.api.http import serve
             from predictionio_tpu.tools.dashboard import DashboardService
 
             print(f"Dashboard is listening on {args.ip}:{args.port}")
-            serve(DashboardService().dispatch, args.ip, args.port)
+            serve(
+                DashboardService().dispatch, args.ip, args.port,
+                ssl_context=_ssl_from_args(args),
+            )
         elif cmd == "adminserver":
             from predictionio_tpu.api.http import serve
             from predictionio_tpu.tools.adminserver import AdminService
 
             print(f"Admin server is listening on {args.ip}:{args.port}")
-            serve(AdminService().dispatch, args.ip, args.port)
+            serve(
+                AdminService().dispatch, args.ip, args.port,
+                ssl_context=_ssl_from_args(args),
+            )
         elif cmd == "batchpredict":
             from predictionio_tpu.tools.batchpredict import run_batch_predict
 

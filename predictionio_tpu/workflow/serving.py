@@ -100,6 +100,11 @@ class QueryService:
         self.instance = None
         self.start_time = _dt.datetime.now(_dt.timezone.utc)
         self.query_count = 0
+        self.feedback_dropped = 0
+        #: set by the transport layer (console deploy): called by
+        #: ``GET /stop`` to shut the HTTP server down (parity:
+        #: CreateServer's stop route / `pio undeploy`)
+        self.stop_server: Any = None
         # one long-lived worker drains feedback posts — per-query threads
         # would grow unboundedly when the event server is slow
         self._feedback_queue: "queue.Queue | None" = None
@@ -238,7 +243,10 @@ class QueryService:
         try:
             self._feedback_queue.put_nowait((url, event))
         except queue.Full:
-            # feedback is best-effort telemetry; never stall the query path
+            # feedback is best-effort telemetry; never stall the query
+            # path — but surface the loss to operators via status_json
+            with self._lock:
+                self.feedback_dropped += 1
             logger.warning("Feedback queue full; dropping prediction event")
 
     # -------------------------------------------------------------- status
@@ -252,6 +260,7 @@ class QueryService:
             "engineInstanceId": inst.id if inst else None,
             "startTime": self.start_time.isoformat(),
             "queryCount": self.query_count,
+            "feedbackDropped": self.feedback_dropped,
             "plugins": [
                 {"name": p.name, "type": p.plugin_type} for p in self.plugins
             ],
@@ -281,6 +290,15 @@ class QueryService:
                 return Response(200, {"message": "Reloaded"})
             except QueryServerError as e:
                 return Response(500, {"message": str(e)})
+        if path == "/stop" and method == "GET":
+            # parity: CreateServer's stop route; the transport sets
+            # stop_server so the response is written before shutdown
+            if self.stop_server is None:
+                return Response(
+                    501, {"message": "This deployment has no stop hook."}
+                )
+            self.stop_server()
+            return Response(200, {"message": "Shutting down."})
         if path == "/profiler/start" and method == "POST":
             # jax.profiler trace capture (SURVEY.md section 6.1 rebuild
             # surface); view the dump with TensorBoard/XProf

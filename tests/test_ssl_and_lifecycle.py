@@ -1,0 +1,217 @@
+"""HTTPS on both servers (parity: common/SSLConfiguration.scala — one TLS
+layer shared by the event and query servers) and the deploy lifecycle:
+GET /stop, `pio undeploy`, and the stop hook wiring."""
+
+import datetime as dt
+import json
+import ssl
+import urllib.request
+
+import pytest
+
+from predictionio_tpu.api.http import make_ssl_context, start_background
+
+
+@pytest.fixture(scope="module")
+def cert_pair(tmp_path_factory):
+    """Self-signed localhost cert via the `cryptography` package."""
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    from cryptography.x509.oid import NameOID
+
+    d = tmp_path_factory.mktemp("certs")
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, "localhost")])
+    now = dt.datetime.now(dt.timezone.utc)
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(name)
+        .issuer_name(name)
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - dt.timedelta(minutes=5))
+        .not_valid_after(now + dt.timedelta(days=1))
+        .add_extension(
+            x509.SubjectAlternativeName([x509.DNSName("localhost")]), critical=False
+        )
+        .sign(key, hashes.SHA256())
+    )
+    cert_path = d / "server.crt"
+    key_path = d / "server.key"
+    cert_path.write_bytes(cert.public_bytes(serialization.Encoding.PEM))
+    key_path.write_bytes(
+        key.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.TraditionalOpenSSL,
+            serialization.NoEncryption(),
+        )
+    )
+    return str(cert_path), str(key_path)
+
+
+def _client_ctx():
+    ctx = ssl.create_default_context()
+    ctx.check_hostname = False
+    ctx.verify_mode = ssl.CERT_NONE
+    return ctx
+
+
+def _get(url, ctx=None, data=None, method=None):
+    req = urllib.request.Request(
+        url, data=data, method=method,
+        headers={"Content-Type": "application/json"} if data else {},
+    )
+    with urllib.request.urlopen(req, timeout=10, context=ctx) as resp:
+        return resp.status, json.loads(resp.read() or b"{}")
+
+
+class TestHTTPS:
+    def test_event_server_over_https(self, cert_pair, memory_storage_env):
+        from predictionio_tpu.api import EventService
+        from predictionio_tpu.data.storage.base import App
+        from predictionio_tpu.data.storage.base import AccessKey
+
+        apps = memory_storage_env.get_meta_data_apps()
+        app_id = apps.insert(App(id=0, name="sslapp"))
+        memory_storage_env.get_meta_data_access_keys().insert(
+            AccessKey(key="sslkey", appid=app_id, events=[])
+        )
+        memory_storage_env.get_l_events().init(app_id)
+        server, _ = start_background(
+            EventService().dispatch,
+            ssl_context=make_ssl_context(*cert_pair),
+        )
+        try:
+            port = server.server_address[1]
+            status, body = _get(
+                f"https://localhost:{port}/events.json?accessKey=sslkey",
+                ctx=_client_ctx(),
+                data=json.dumps(
+                    {"event": "rate", "entityType": "user", "entityId": "1"}
+                ).encode(),
+            )
+            assert status == 201 and body["eventId"]
+            # plaintext against the TLS socket must fail
+            with pytest.raises(Exception):
+                urllib.request.urlopen(
+                    f"http://localhost:{port}/", timeout=5
+                ).read()
+        finally:
+            server.shutdown()
+            server.server_close()
+
+    def test_query_server_over_https_with_stop(self, cert_pair, trained_variant):
+        from predictionio_tpu.workflow.serving import QueryService
+
+        qs = QueryService(trained_variant)
+        server, thread = start_background(
+            qs.dispatch, ssl_context=make_ssl_context(*cert_pair)
+        )
+        stopped = []
+        qs.stop_server = lambda: stopped.append(True) or server.shutdown()
+        port = server.server_address[1]
+        try:
+            status, body = _get(
+                f"https://localhost:{port}/", ctx=_client_ctx()
+            )
+            assert status == 200 and body["status"] == "alive"
+            assert "feedbackDropped" in body
+            status, body = _get(
+                f"https://localhost:{port}/stop", ctx=_client_ctx()
+            )
+            assert status == 200 and stopped
+            thread.join(timeout=10)
+            assert not thread.is_alive()
+        finally:
+            server.server_close()
+
+    def test_ssl_context_from_env(self, cert_pair, monkeypatch):
+        from predictionio_tpu.api.http import ssl_context_from_env
+
+        monkeypatch.delenv("PIO_SSL_CERT", raising=False)
+        monkeypatch.delenv("PIO_SSL_KEY", raising=False)
+        assert ssl_context_from_env() is None
+        monkeypatch.setenv("PIO_SSL_CERT", cert_pair[0])
+        monkeypatch.setenv("PIO_SSL_KEY", cert_pair[1])
+        assert isinstance(ssl_context_from_env(), ssl.SSLContext)
+
+
+@pytest.fixture()
+def trained_variant(memory_storage_env):
+    """A tiny trained Recommendation engine ready to deploy."""
+    import numpy as np
+
+    from predictionio_tpu.controller import local_context
+    from predictionio_tpu.data.event import DataMap, Event
+    from predictionio_tpu.data.storage.base import App
+    from predictionio_tpu.workflow import load_engine_variant, run_train
+
+    app_id = memory_storage_env.get_meta_data_apps().insert(App(id=0, name="lcapp"))
+    le = memory_storage_env.get_l_events()
+    le.init(app_id)
+    rng = np.random.default_rng(0)
+    for _ in range(200):
+        le.insert(
+            Event(
+                event="rate",
+                entity_type="user",
+                entity_id=str(rng.integers(0, 20)),
+                target_entity_type="item",
+                target_entity_id=str(rng.integers(0, 15)),
+                properties=DataMap({"rating": float(rng.integers(1, 6))}),
+            ),
+            app_id,
+        )
+    variant = load_engine_variant(
+        {
+            "id": "lc-rec",
+            "version": "1",
+            "engineFactory": "predictionio_tpu.templates.recommendation:engine_factory",
+            "datasource": {"params": {"appName": "lcapp"}},
+            "algorithms": [
+                {"name": "als", "params": {"rank": 4, "numIterations": 2, "lambda": 0.1}}
+            ],
+        }
+    )
+    run_train(variant, local_context())
+    return variant
+
+
+class TestLifecycle:
+    def test_stop_without_hook_is_501(self, trained_variant):
+        from predictionio_tpu.workflow.serving import QueryService
+
+        qs = QueryService(trained_variant)
+        resp = qs.dispatch("GET", "/stop", {})
+        assert resp.status == 501
+
+    def test_deploy_query_undeploy_roundtrip(self, trained_variant):
+        """The full lifecycle over real HTTP: deploy -> query -> undeploy
+        (`pio undeploy` = GET /stop) -> server actually exits."""
+        from predictionio_tpu.tools import commands
+        from predictionio_tpu.workflow.serving import QueryService
+
+        qs = QueryService(trained_variant)
+        server, thread = start_background(qs.dispatch)
+        qs.stop_server = server.shutdown
+        port = server.server_address[1]
+        try:
+            status, body = _get(
+                f"http://127.0.0.1:{port}/queries.json",
+                data=json.dumps({"user": "3", "num": 2}).encode(),
+            )
+            assert status == 200 and "itemScores" in body
+            out = []
+            commands.undeploy("127.0.0.1", port, out=out.append)
+            assert "Undeployed" in out[0]
+            thread.join(timeout=10)
+            assert not thread.is_alive()
+        finally:
+            server.server_close()
+
+    def test_undeploy_unreachable_raises(self):
+        from predictionio_tpu.tools import commands
+
+        with pytest.raises(RuntimeError, match="Could not reach"):
+            commands.undeploy("127.0.0.1", 1, out=lambda _: None)
