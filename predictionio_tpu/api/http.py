@@ -49,8 +49,14 @@ def ssl_context_from_env() -> ssl.SSLContext | None:
     layer of the config triad (SURVEY.md section 6.6)."""
     cert = os.environ.get("PIO_SSL_CERT")
     key = os.environ.get("PIO_SSL_KEY")
-    if not cert or not key:
+    if not cert and not key:
         return None
+    if bool(cert) != bool(key):
+        # refuse to silently serve plaintext when the operator set half
+        # the pair — same contract as the --cert/--key flags
+        raise ValueError(
+            "PIO_SSL_CERT and PIO_SSL_KEY must be set together"
+        )
     return make_ssl_context(cert, key, os.environ.get("PIO_SSL_KEY_PASSWORD"))
 
 #: signature shared with EventService.dispatch / QueryService.dispatch
@@ -60,6 +66,10 @@ Dispatcher = Callable[..., "object"]
 def _make_handler(dispatch: Dispatcher):
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
+        #: per-connection socket timeout — bounds stalled clients (incl.
+        #: the lazy TLS handshake, which runs on first I/O in this
+        #: worker thread; see _make_server)
+        timeout = 60
 
         def log_message(self, fmt, *args):  # route through logging, not stderr
             logger.debug("%s - %s", self.address_string(), fmt % args)
@@ -134,7 +144,14 @@ def _make_server(
 ) -> ThreadingHTTPServer:
     server = ThreadingHTTPServer((host, port), _make_handler(dispatch))
     if ssl_context is not None:
-        server.socket = ssl_context.wrap_socket(server.socket, server_side=True)
+        # defer the handshake to the per-connection worker thread: with
+        # do_handshake_on_connect=True it would run inside accept() on
+        # the serve_forever thread, letting ONE stalled client block the
+        # whole server. Lazily it runs on first read under the handler's
+        # socket timeout instead.
+        server.socket = ssl_context.wrap_socket(
+            server.socket, server_side=True, do_handshake_on_connect=False
+        )
     return server
 
 

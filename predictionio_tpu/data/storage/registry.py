@@ -44,6 +44,8 @@ _BUILTIN_DRIVERS = {
     "sqlite": "predictionio_tpu.data.storage.sqlite",
     "memory": "predictionio_tpu.data.storage.memory",
     "localfs": "predictionio_tpu.data.storage.localfs",
+    "remote": "predictionio_tpu.data.storage.remote",
+    "sharedfs": "predictionio_tpu.data.storage.sharedfs",
 }
 
 

@@ -1,0 +1,107 @@
+"""Shared-filesystem model-blob driver (multi-host deploy path).
+
+Parity: ``data/storage/hdfs/HDFSModels.scala`` / ``storage/s3`` — a model
+store every host can reach, so a blob written by the training host (host 0
+of a ``jax.distributed`` job) is loadable by any serving host. The TPU-era
+equivalent of HDFS is a shared mount (NFS, GCS-fuse, Filestore), so this
+driver is ``localfs`` hardened for concurrent multi-host use:
+
+* temp files carry a host+pid+random suffix — two hosts writing the same
+  model id never collide on the temp name;
+* data and directory are fsync'd before the atomic rename, so a reader
+  on another host never observes a torn blob through close-to-open
+  consistency (NFS) after the rename is visible;
+* reads retry once on a concurrent replace.
+
+Config::
+
+    PIO_STORAGE_SOURCES_<ID>_TYPE=sharedfs
+    PIO_STORAGE_SOURCES_<ID>_PATH=/mnt/shared/pio-models
+    PIO_STORAGE_SOURCES_<ID>_FSYNC=true   # optional (default true)
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import uuid
+
+from predictionio_tpu.data.storage.base import (
+    BaseStorageClient,
+    Model,
+    ModelsRepo,
+    StorageClientConfig,
+    StorageError,
+)
+from predictionio_tpu.data.storage.localfs import _FsModels
+
+__all__ = ["StorageClient"]
+
+
+class _SharedFsModels(_FsModels):
+    """Extends the localfs store (same paths/sanitization — a model
+    written by either driver is readable by the other) with the
+    concurrent-multi-host hardening documented above."""
+
+    def __init__(self, base: str, fsync: bool = True):
+        super().__init__(base)
+        self._fsync = fsync
+
+    def insert(self, model: Model) -> None:
+        final = self._path(model.id)
+        # host-unique temp name: concurrent writers on different hosts of a
+        # shared mount must never collide before the atomic rename
+        tmp = (
+            f"{final}.tmp.{socket.gethostname()}.{os.getpid()}.{uuid.uuid4().hex[:8]}"
+        )
+        try:
+            with open(tmp, "wb") as f:
+                f.write(model.models)
+                if self._fsync:
+                    f.flush()
+                    os.fsync(f.fileno())
+            os.replace(tmp, final)
+            if self._fsync:
+                # persist the rename itself (directory entry) before
+                # reporting success to the trainer
+                dir_fd = os.open(self._base, os.O_RDONLY)
+                try:
+                    os.fsync(dir_fd)
+                finally:
+                    os.close(dir_fd)
+        finally:
+            if os.path.exists(tmp):
+                os.remove(tmp)
+
+    def get(self, model_id: str) -> Model | None:
+        path = self._path(model_id)
+        for _ in range(2):  # retry once across a concurrent os.replace
+            try:
+                with open(path, "rb") as f:
+                    return Model(id=model_id, models=f.read())
+            except FileNotFoundError:
+                if not os.path.exists(path):
+                    return None
+        return None
+
+    def delete(self, model_id: str) -> bool:
+        try:
+            os.remove(self._path(model_id))
+            return True
+        except FileNotFoundError:
+            return False
+
+
+class StorageClient(BaseStorageClient):
+    """Shared-mount model driver (``TYPE=sharedfs``; ``PATH`` = directory)."""
+
+    def __init__(self, config: StorageClientConfig):
+        super().__init__(config)
+        path = config.properties.get("path")
+        if not path:
+            raise StorageError("sharedfs driver requires a PATH property")
+        fsync = config.properties.get("fsync", "true").lower() != "false"
+        self._models = _SharedFsModels(os.path.expanduser(path), fsync)
+
+    def get_models(self) -> ModelsRepo:
+        return self._models

@@ -161,6 +161,20 @@ def build_parser() -> argparse.ArgumentParser:
     adm.add_argument("--port", type=int, default=7071)
     add_ssl_flags(adm)
 
+    # ---- storageserver
+    ss = sub.add_parser(
+        "storageserver",
+        help="expose this host's storage backend over the network "
+        "(server side of the TYPE=remote driver)",
+    )
+    ss.add_argument("--ip", default="0.0.0.0")
+    ss.add_argument("--port", type=int, default=7072)
+    ss.add_argument(
+        "--secret", default=None,
+        help="shared secret clients must present (default: $PIO_STORAGE_SERVER_SECRET)",
+    )
+    add_ssl_flags(ss)
+
     # ---- batchpredict
     bp = sub.add_parser("batchpredict", help="bulk predictions from a query file")
     bp.add_argument("--engine-json", default="engine.json")
@@ -351,6 +365,18 @@ def main(argv: list[str] | None = None) -> int:
             print(f"Admin server is listening on {args.ip}:{args.port}")
             serve(
                 AdminService().dispatch, args.ip, args.port,
+                ssl_context=_ssl_from_args(args),
+            )
+        elif cmd == "storageserver":
+            import os
+
+            from predictionio_tpu.api.http import serve
+            from predictionio_tpu.data.storage.remote import StorageRpcService
+
+            secret = args.secret or os.environ.get("PIO_STORAGE_SERVER_SECRET")
+            print(f"Storage server is listening on {args.ip}:{args.port}")
+            serve(
+                StorageRpcService(secret=secret).dispatch, args.ip, args.port,
                 ssl_context=_ssl_from_args(args),
             )
         elif cmd == "batchpredict":

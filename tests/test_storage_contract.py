@@ -19,27 +19,56 @@ from predictionio_tpu.data.storage.base import (
     Model,
     StorageClientConfig,
 )
-from predictionio_tpu.data.storage import localfs, memory, sqlite
+from predictionio_tpu.data.storage import localfs, memory, remote, sharedfs, sqlite
 
 UTC = dt.timezone.utc
 APP = 7
 
 
 def _client(kind: str, tmp_path):
+    """Returns (client, closer)."""
     if kind == "memory":
-        return memory.StorageClient(StorageClientConfig("T", "memory"))
+        c = memory.StorageClient(StorageClientConfig("T", "memory"))
+        return c, c.close
     if kind == "sqlite":
-        return sqlite.StorageClient(
+        c = sqlite.StorageClient(
             StorageClientConfig("T", "sqlite", {"path": str(tmp_path / "t.db")})
         )
+        return c, c.close
+    if kind == "remote":
+        # the networked tri-role backend: a live storage server (wrapping
+        # sqlite) on a real socket, spoken to by the TYPE=remote driver —
+        # the same spec must hold across the wire
+        from predictionio_tpu.api.http import start_background
+
+        backing = sqlite.StorageClient(
+            StorageClientConfig("B", "sqlite", {"path": str(tmp_path / "b.db")})
+        )
+        server, _ = start_background(
+            remote.StorageRpcService(client=backing).dispatch
+        )
+        c = remote.StorageClient(
+            StorageClientConfig(
+                "R", "remote",
+                {"hosts": "127.0.0.1", "ports": str(server.server_address[1])},
+            )
+        )
+
+        def closer():
+            c.close()
+            server.shutdown()
+            server.server_close()
+            backing.close()
+
+        return c, closer
     raise AssertionError(kind)
 
 
-@pytest.fixture(params=["memory", "sqlite"])
+@pytest.fixture(params=["memory", "sqlite", "remote"])
 def client(request, tmp_path):
-    c = _client(request.param, tmp_path)
+    c, closer = _client(request.param, tmp_path)
     yield c
-    c.close()
+    closer()
 
 
 def _ev(name="rate", entity="u1", target=None, t=0, props=None):
@@ -201,15 +230,31 @@ class TestMetadataContract:
         assert models.delete("inst1") and models.get("inst1") is None
 
 
-class TestLocalFsModels:
-    def test_blob_roundtrip(self, tmp_path):
-        c = localfs.StorageClient(
-            StorageClientConfig("FS", "localfs", {"path": str(tmp_path / "m")}))
+class TestFsModels:
+    @pytest.fixture(params=["localfs", "sharedfs"])
+    def fs_client(self, request, tmp_path):
+        mod = {"localfs": localfs, "sharedfs": sharedfs}[request.param]
+        return mod.StorageClient(
+            StorageClientConfig(
+                "FS", request.param, {"path": str(tmp_path / "m")}
+            )
+        )
+
+    def test_blob_roundtrip(self, fs_client):
+        c = fs_client
         blob = bytes(range(256)) * 10
         c.get_models().insert(Model("abc/def", blob))  # id gets sanitized
         assert c.get_models().get("abc/def").models == blob
         assert c.get_models().delete("abc/def")
         assert c.get_models().get("abc/def") is None
+
+    def test_overwrite_and_missing(self, fs_client):
+        m = fs_client.get_models()
+        m.insert(Model("x", b"v1"))
+        m.insert(Model("x", b"v2"))
+        assert m.get("x").models == b"v2"
+        assert m.get("nope") is None
+        assert not m.delete("nope")
 
 
 class TestReviewRegressions:
