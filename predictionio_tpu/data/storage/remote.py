@@ -737,6 +737,10 @@ class StorageRpcService:
         ent = _ENTITY_ARGS.get((role, method))
         if ent is not None:
             name, dec = ent
+            if name not in kwargs:
+                raise StorageError(
+                    f"'{role}.{method}' requires argument '{name}'"
+                )
             kwargs[name] = dec(kwargs[name])
         if role in ("l_events", "p_events"):
             if "event" in kwargs:
@@ -775,7 +779,9 @@ class StorageRpcService:
                 ),
                 None,
             )
-            if given != self._secret:
+            import hmac
+
+            if not hmac.compare_digest(given or "", self._secret):
                 return Response(401, {"error": "invalid storage secret"})
         if not isinstance(body, Mapping) or "repo" not in body or "method" not in body:
             return Response(400, {"error": "body must be {repo, method, args}"})

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 
 from predictionio_tpu.tools import commands
@@ -222,6 +223,15 @@ def _ssl_from_args(args):
 
 
 def main(argv: list[str] | None = None) -> int:
+    # PIO_JAX_PLATFORMS=cpu forces the JAX platform even when the
+    # interpreter preloaded jax with a different one (CPU CI runs,
+    # multi-host rehearsals on hosts whose default platform is a single
+    # accelerator). Must happen before any backend initializes.
+    platform_override = os.environ.get("PIO_JAX_PLATFORMS")
+    if platform_override:
+        import jax
+
+        jax.config.update("jax_platforms", platform_override)
     args = build_parser().parse_args(argv)
     cmd = args.command
     try:
@@ -368,8 +378,6 @@ def main(argv: list[str] | None = None) -> int:
                 ssl_context=_ssl_from_args(args),
             )
         elif cmd == "storageserver":
-            import os
-
             from predictionio_tpu.api.http import serve
             from predictionio_tpu.data.storage.remote import StorageRpcService
 
