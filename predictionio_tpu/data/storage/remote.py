@@ -781,7 +781,11 @@ class StorageRpcService:
             )
             import hmac
 
-            if not hmac.compare_digest(given or "", self._secret):
+            # compare as bytes: compare_digest raises TypeError on
+            # non-ASCII str input (-> 500 instead of the intended 401)
+            if not hmac.compare_digest(
+                (given or "").encode(), self._secret.encode()
+            ):
                 return Response(401, {"error": "invalid storage secret"})
         if not isinstance(body, Mapping) or "repo" not in body or "method" not in body:
             return Response(400, {"error": "body must be {repo, method, args}"})

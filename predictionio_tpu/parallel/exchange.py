@@ -125,8 +125,19 @@ def _p2p_host() -> str:
         return override
     host = socket.gethostname()
     try:
-        socket.gethostbyname(host)
+        resolved = socket.gethostbyname(host)
+    except OSError:
+        resolved = ""
+    if resolved and not resolved.startswith("127."):
         return host
+    # hostname resolves to loopback (the Debian '127.0.1.1 <hostname>'
+    # convention) — peers dialing it would hit themselves. Use the
+    # route-out interface address instead; loopback only as a last
+    # resort (correct for single-machine multi-process tests).
+    try:
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            s.connect(("10.255.255.255", 1))  # no packets sent; routes only
+            return s.getsockname()[0]
     except OSError:
         return "127.0.0.1"
 
@@ -165,6 +176,12 @@ def pairwise_exchange(payloads: Sequence[bytes], timeout: float = 300.0) -> list
             with conn:
                 conn.settimeout(timeout)
                 rank, length = _HDR.unpack(_recv_exact(conn, _HDR.size))
+                # reject garbage/stray connections: an unvalidated rank
+                # (esp. negative) would silently overwrite a peer's slot
+                if not (0 <= rank < P) or rank == me or length < 0:
+                    raise ConnectionError(
+                        f"invalid peer header (rank={rank}, len={length})"
+                    )
                 results[rank] = _recv_exact(conn, length)
                 _count("p2p_received", length)
         except Exception as e:  # surfaced after join

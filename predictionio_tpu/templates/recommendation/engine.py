@@ -15,6 +15,7 @@ Parity map (reference scala-parallel-recommendation template):
 from __future__ import annotations
 
 import dataclasses
+import logging
 from typing import Any, Sequence
 
 import numpy as np
@@ -237,11 +238,19 @@ class ALSAlgorithmParams(Params):
     #: huge catalogs or when queries are batched — and avoids it when the
     #: TPU sits behind a network tunnel where each dispatch pays an RTT.
     serve_on_device: bool = False
+    #: guardrail for serve_on_device: a deploy-time probe measures real
+    #: per-query device latency and falls back to host serving (with a
+    #: warning) when the median exceeds this budget — a remote/tunneled
+    #: accelerator pays an RTT per dispatch that silently blows the
+    #: reference's <10 ms serving target otherwise. <= 0 disables the
+    #: probe (always trust serve_on_device).
+    device_latency_budget_ms: float = 10.0
     json_aliases = {
         "numIterations": "num_iterations",
         "lambda": "lambda_",
         "implicitPrefs": "implicit_prefs",
         "serveOnDevice": "serve_on_device",
+        "deviceLatencyBudgetMs": "device_latency_budget_ms",
     }
 
 
@@ -294,9 +303,33 @@ class ALSAlgorithm(JaxAlgorithm):
 
             model.user_factors = jax.device_put(np.asarray(model.user_factors))
             model.item_factors = jax.device_put(np.asarray(model.item_factors))
-        else:
-            model.user_factors = np.ascontiguousarray(model.user_factors)
-            model.item_factors = np.ascontiguousarray(model.item_factors)
+            if len(model.user_index):
+                probe = Query(user=model.user_index.keys()[0], num=4)
+                self.predict(model, probe)  # compile warm-up
+                budget = self.params.device_latency_budget_ms
+                if budget > 0:
+                    import time
+
+                    lat = []
+                    for _ in range(5):
+                        t0 = time.perf_counter()
+                        self.predict(model, probe)
+                        lat.append((time.perf_counter() - t0) * 1e3)
+                    p50 = sorted(lat)[len(lat) // 2]
+                    if p50 > budget:
+                        logging.getLogger(__name__).warning(
+                            "serveOnDevice probe: median device query "
+                            "latency %.1f ms exceeds the %.1f ms budget "
+                            "(remote/tunneled accelerator?) — falling "
+                            "back to host serving. Set "
+                            "deviceLatencyBudgetMs <= 0 to force device.",
+                            p50, budget,
+                        )
+                        model.user_factors = np.asarray(model.user_factors)
+                        model.item_factors = np.asarray(model.item_factors)
+            return model
+        model.user_factors = np.ascontiguousarray(model.user_factors)
+        model.item_factors = np.ascontiguousarray(model.item_factors)
         # warm-up so the first real query pays no compile / cache fill
         # (parity: CreateServer's deploy-time warm-up)
         if len(model.user_index):
