@@ -1,0 +1,256 @@
+"""Two-tower retrieval, TPU-native (the DLRM/two-tower stretch family —
+BASELINE.md configs[4]; no reference counterpart exists: PredictionIO has
+no deep-retrieval template, so this is parity-plus).
+
+TPU-first design:
+
+* **Sharded embedding tables (EP)** — the user and item tables are
+  sharded row-wise over the mesh's ``model`` axis. Lookups use the same
+  shard-local-gather + psum pattern as the ALS sweep
+  (:func:`predictionio_tpu.ops.als._gram_chunk`): under ``shard_map``
+  each device gathers only ids living in its local shard (others masked
+  to zero) and the partial embeddings psum over ``model`` — the
+  catalog-sized tables never replicate, so table size scales with the
+  mesh. The pattern is differentiable: the gather's VJP is a
+  scatter-add into the LOCAL shard, so gradients stay sharded too.
+* **Data-parallel batches** — interaction batches shard over ``data``;
+  the in-batch logits matrix psums gradients across the batch via
+  GSPMD's normal propagation.
+* **In-batch sampled softmax** — each positive (u, i) pair treats the
+  other items in the batch as negatives (symmetric u→i and i→u cross
+  entropy). Standard two-tower training; duplicate items inside a batch
+  act as false negatives, acceptable at the batch sizes used here.
+* **Static shapes** — interactions are padded to a multiple of the
+  batch size and each step ``dynamic_slice``s its batch from the
+  device-resident permutation, so one compiled step serves the whole
+  run.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+__all__ = [
+    "TwoTowerConfig",
+    "TwoTowerModel",
+    "sharded_embedding_lookup",
+    "train_two_tower",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class TwoTowerConfig:
+    dim: int = 32
+    batch_size: int = 256
+    epochs: int = 5
+    learning_rate: float = 0.05
+    temperature: float = 0.1
+    seed: int = 0
+    #: report the training loss every N steps (host readback)
+    log_every: int = 50
+
+
+class TwoTowerModel(NamedTuple):
+    """Serving-ready tower outputs: dot(user_vec, item_vec) ranks items.
+    Rows are L2-normalized, so scores are cosine similarities."""
+
+    user_vecs: Any  # [U, D]
+    item_vecs: Any  # [I, D]
+    loss_history: tuple  # ((step, loss), ...)
+
+
+def sharded_embedding_lookup(
+    table: jax.Array,  # [N_pad, D], sharded over model axis rows
+    ids: jax.Array,  # [B] int32
+    mesh: Mesh | None,
+    data_axis: str | None = "data",
+    model_axis: str | None = "model",
+) -> jax.Array:
+    """Differentiable embedding lookup from a model-sharded table.
+
+    Each device gathers only the rows of its local shard (out-of-shard
+    ids contribute zero) and the partials psum over ``model`` — the
+    table never materializes replicated, and the VJP scatter-adds into
+    the local shard so gradients stay sharded (VERDICT r2 item 10: the
+    sharded-embedding consumer of the ALS chunked-gather machinery)."""
+    if mesh is None or model_axis is None or model_axis not in mesh.shape:
+        return table[ids]
+    S = int(mesh.shape[model_axis])
+    if table.shape[0] % S:
+        # a floored rps would make trailing rows unreachable and return
+        # silently-zero embeddings for their ids
+        raise ValueError(
+            f"table rows ({table.shape[0]}) must divide the model axis ({S})"
+        )
+    rps = table.shape[0] // S
+
+    def local(tbl, ids_l):
+        me = jax.lax.axis_index(model_axis)
+        lidx = ids_l - me * rps
+        inr = (lidx >= 0) & (lidx < rps)
+        e = tbl[jnp.where(inr, lidx, 0)] * inr[:, None].astype(tbl.dtype)
+        return jax.lax.psum(e, model_axis)
+
+    return jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(PartitionSpec(model_axis, None), PartitionSpec(data_axis)),
+        out_specs=PartitionSpec(data_axis, None),
+    )(table, ids)
+
+
+def _pad_rows(n: int, mult: int) -> int:
+    return -(-n // mult) * mult
+
+
+def train_two_tower(
+    rows: np.ndarray,
+    cols: np.ndarray,
+    num_users: int,
+    num_items: int,
+    config: TwoTowerConfig = TwoTowerConfig(),
+    mesh: Mesh | None = None,
+    data_axis: str = "data",
+    model_axis: str = "model",
+) -> TwoTowerModel:
+    """Train user/item towers from implicit interaction pairs.
+
+    ``rows[i]``/``cols[i]`` is one (user, item) interaction. Returns
+    L2-normalized tower vectors as replicated host-readable arrays.
+    """
+    rows = np.asarray(rows, dtype=np.int64)
+    cols = np.asarray(cols, dtype=np.int64)
+    if rows.shape != cols.shape or rows.ndim != 1:
+        raise ValueError("rows/cols must be equal-length 1-D arrays")
+    if rows.size == 0:
+        raise ValueError("two-tower training needs at least one interaction")
+    if rows.min() < 0 or rows.max() >= num_users:
+        raise ValueError("row index out of range")
+    if cols.min() < 0 or cols.max() >= num_items:
+        raise ValueError("column index out of range")
+
+    S = 1
+    if mesh is not None and model_axis in mesh.shape:
+        S = int(mesh.shape[model_axis])
+    elif mesh is not None:
+        model_axis = None
+    D = config.dim
+    n_u = _pad_rows(num_users, S)
+    n_i = _pad_rows(num_items, S)
+
+    B = config.batch_size
+    if mesh is not None:
+        d_size = int(mesh.shape.get(data_axis, 1))
+        B = _pad_rows(B, d_size)
+
+    key = jax.random.PRNGKey(config.seed)
+    k_u, k_i, k_perm = jax.random.split(key, 3)
+    scale = 1.0 / np.sqrt(D)
+    params = {
+        "user": jax.random.normal(k_u, (n_u, D), jnp.float32) * scale,
+        "item": jax.random.normal(k_i, (n_i, D), jnp.float32) * scale,
+    }
+    if mesh is not None:
+        spec = (
+            PartitionSpec(model_axis, None)
+            if model_axis
+            else PartitionSpec(None, None)
+        )
+        sharded = NamedSharding(mesh, spec)
+        params = {k: jax.device_put(v, sharded) for k, v in params.items()}
+
+    # pad interactions to a whole number of batches by resampling real
+    # pairs (padding with a sentinel would inject a fake item)
+    nnz = rows.size
+    n_pad = _pad_rows(nnz, B)
+    reps = np.arange(n_pad) % nnz
+    perm = np.asarray(jax.random.permutation(k_perm, n_pad))
+    r_all = jnp.asarray(rows[reps][perm].astype(np.int32))
+    c_all = jnp.asarray(cols[reps][perm].astype(np.int32))
+    if mesh is not None:
+        rep = NamedSharding(mesh, PartitionSpec())
+        r_all = jax.device_put(r_all, rep)
+        c_all = jax.device_put(c_all, rep)
+
+    tx = optax.adam(config.learning_rate)
+    opt_state = tx.init(params)
+    steps_per_epoch = n_pad // B
+    inv_temp = 1.0 / config.temperature
+
+    def loss_fn(p, u_ids, i_ids):
+        ue = sharded_embedding_lookup(p["user"], u_ids, mesh, data_axis, model_axis)
+        ie = sharded_embedding_lookup(p["item"], i_ids, mesh, data_axis, model_axis)
+        ue = ue / (jnp.linalg.norm(ue, axis=-1, keepdims=True) + 1e-8)
+        ie = ie / (jnp.linalg.norm(ie, axis=-1, keepdims=True) + 1e-8)
+        labels = jnp.arange(B)
+        if mesh is not None:
+            # in-batch logits need every negative on every device: keep
+            # the LEFT side batch-sharded and replicate the right side (a
+            # tiny [B, D] all-gather) — [B@data, B@data] is not a legal
+            # layout, and labels must shard like the logits rows
+            rep = NamedSharding(mesh, PartitionSpec(None, None))
+            ue_r = jax.sharding.reshard(ue, rep)
+            ie_r = jax.sharding.reshard(ie, rep)
+            labels = jax.sharding.reshard(
+                labels, NamedSharding(mesh, PartitionSpec(data_axis))
+            )
+        else:
+            ue_r, ie_r = ue, ie
+        # symmetric in-batch softmax: user->item and item->user
+        l1 = optax.softmax_cross_entropy_with_integer_labels(
+            (ue @ ie_r.T) * inv_temp, labels
+        )
+        l2 = optax.softmax_cross_entropy_with_integer_labels(
+            (ie @ ue_r.T) * inv_temp, labels
+        )
+        return 0.5 * (l1.mean() + l2.mean())
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def train_step(p, o, step):
+        off = (step % steps_per_epoch) * B
+        u_ids = jax.lax.dynamic_slice(r_all, (off,), (B,))
+        i_ids = jax.lax.dynamic_slice(c_all, (off,), (B,))
+        if mesh is not None:
+            # reshard, not with_sharding_constraint: make_mesh axes are
+            # Explicit in current jax, and the batch must be data-sharded
+            # before entering the shard_map lookups
+            bspec = NamedSharding(mesh, PartitionSpec(data_axis))
+            u_ids = jax.sharding.reshard(u_ids, bspec)
+            i_ids = jax.sharding.reshard(i_ids, bspec)
+        loss, grads = jax.value_and_grad(loss_fn)(p, u_ids, i_ids)
+        updates, o = tx.update(grads, o, p)
+        return optax.apply_updates(p, updates), o, loss
+
+    history = []
+    total_steps = config.epochs * steps_per_epoch
+    for step in range(total_steps):
+        params, opt_state, loss = train_step(params, opt_state, step)
+        if step % config.log_every == 0 or step == total_steps - 1:
+            history.append((step, float(loss)))
+
+    def _finalize(p):
+        u = p["user"] / (jnp.linalg.norm(p["user"], axis=-1, keepdims=True) + 1e-8)
+        v = p["item"] / (jnp.linalg.norm(p["item"], axis=-1, keepdims=True) + 1e-8)
+        return u, v
+
+    if mesh is not None:
+        # replicate before the host reads the (possibly model-sharded)
+        # tables; slicing off the padding rows happens host-side
+        u, v = jax.jit(
+            _finalize, out_shardings=NamedSharding(mesh, PartitionSpec())
+        )(params)
+    else:
+        u, v = jax.jit(_finalize)(params)
+    return TwoTowerModel(
+        user_vecs=np.asarray(u)[:num_users],
+        item_vecs=np.asarray(v)[:num_items],
+        loss_history=tuple(history),
+    )

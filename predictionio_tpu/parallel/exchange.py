@@ -177,8 +177,12 @@ def pairwise_exchange(payloads: Sequence[bytes], timeout: float = 300.0) -> list
                 conn.settimeout(timeout)
                 rank, length = _HDR.unpack(_recv_exact(conn, _HDR.size))
                 # reject garbage/stray connections: an unvalidated rank
-                # (esp. negative) would silently overwrite a peer's slot
-                if not (0 <= rank < P) or rank == me or length < 0:
+                # (esp. negative) would silently overwrite a peer's slot,
+                # and an absurd length would allocate unbounded memory
+                max_len = int(
+                    os.environ.get("PIO_P2P_MAX_PAYLOAD", str(1 << 33))
+                )
+                if not (0 <= rank < P) or rank == me or not (0 <= length <= max_len):
                     raise ConnectionError(
                         f"invalid peer header (rank={rank}, len={length})"
                     )

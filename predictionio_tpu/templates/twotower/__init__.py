@@ -1,0 +1,3 @@
+from predictionio_tpu.templates.twotower.engine import engine_factory
+
+__all__ = ["engine_factory"]

@@ -1,0 +1,261 @@
+"""Two-Tower retrieval engine: interaction events -> sharded-embedding
+towers -> personalized top-N queries.
+
+The DLRM/two-tower stretch family (BASELINE.md configs[4]). No reference
+counterpart exists — PredictionIO ships no deep-retrieval template — so
+this is parity-plus built on the framework's standard DASE shape:
+
+* DataSource — implicit interaction pairs from the event store (any of
+  ``eventNames``), with the same multi-host coherence recipe as the
+  other templates (merge counts by key, global sorted vocabularies).
+* Algorithm — :func:`predictionio_tpu.ops.twotower.train_two_tower`:
+  embedding tables sharded over the mesh's ``model`` axis (the
+  shard-local-gather + psum lookup shared with the ALS sweep),
+  in-batch sampled-softmax, optax adam.
+* Serving — cosine top-N from the L2-normalized tower outputs with the
+  usual seen-item filter; same Query/PredictedResult wire shapes as the
+  Recommendation template, so SDK clients need no changes.
+
+engine.json::
+
+    {"engineFactory": "predictionio_tpu.templates.twotower:engine_factory",
+     "datasource": {"params": {"appName": "myapp",
+                               "eventNames": ["view", "buy"]}},
+     "algorithms": [{"name": "twotower",
+                     "params": {"embeddingDim": 64, "batchSize": 512,
+                                "epochs": 5, "learningRate": 0.05}}]}
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Sequence
+
+import numpy as np
+
+from predictionio_tpu.controller import (
+    DataSource,
+    Engine,
+    FirstServing,
+    IdentityPreparator,
+    JaxAlgorithm,
+    OptionAverageMetric,
+    Params,
+    SanityCheck,
+    WorkflowContext,
+)
+from predictionio_tpu.data.aggregator import BiMap
+from predictionio_tpu.data.store import PEventStore
+from predictionio_tpu.ops.twotower import TwoTowerConfig, train_two_tower
+
+__all__ = [
+    "DataSourceParams",
+    "TrainingData",
+    "TwoTowerDataSource",
+    "TwoTowerParams",
+    "TwoTowerAlgorithm",
+    "Query",
+    "PredictedResult",
+    "ItemScore",
+    "engine_factory",
+]
+
+
+# ------------------------------------------------------------------- queries
+@dataclasses.dataclass(frozen=True)
+class Query:
+    user: str
+    num: int = 10
+
+
+@dataclasses.dataclass(frozen=True)
+class ItemScore:
+    item: str
+    score: float
+
+    def to_json(self) -> dict:
+        return {"item": self.item, "score": self.score}
+
+
+@dataclasses.dataclass(frozen=True)
+class PredictedResult:
+    item_scores: tuple = ()
+
+    def to_json(self) -> dict:
+        return {"itemScores": [s.to_json() for s in self.item_scores]}
+
+
+# --------------------------------------------------------------- data source
+@dataclasses.dataclass(frozen=True)
+class DataSourceParams(Params):
+    app_name: str = ""
+    event_names: Sequence[str] = ("view", "rate", "buy", "like")
+    json_aliases = {"appName": "app_name", "eventNames": "event_names"}
+
+
+@dataclasses.dataclass
+class TrainingData(SanityCheck):
+    rows: np.ndarray  # user idx, one entry per (user, item) pair
+    cols: np.ndarray  # item idx
+    user_index: BiMap
+    item_index: BiMap
+    seen: dict  # user id -> set of item ids (serving-time filter)
+
+    def sanity_check(self) -> None:
+        if self.rows.size == 0:
+            raise ValueError("No interaction events found — check appName/eventNames")
+
+
+class TwoTowerDataSource(DataSource):
+    params_class = DataSourceParams
+
+    def __init__(self, params: DataSourceParams):
+        super().__init__(params)
+
+    def read_training(self, ctx: WorkflowContext) -> TrainingData:
+        p = self.params
+        # training consumes distinct (user, item) PAIRS — in-batch softmax
+        # has no per-pair weight, so a set (not counts) is the right shape
+        pairs: dict[tuple[str, str], bool] = {}
+        for e in PEventStore.find(
+            app_name=p.app_name,
+            event_names=list(p.event_names),
+            shard_index=ctx.host_index,
+            num_shards=ctx.num_hosts,
+        ):
+            if e.target_entity_id is None:
+                continue
+            pairs[(e.entity_id, e.target_entity_id)] = True
+        if ctx.num_hosts > 1:
+            from predictionio_tpu.parallel.exchange import global_vocab, merge_keyed
+
+            # set-union across hosts: duplicates collapse to one pair
+            pairs = merge_keyed(pairs, combine=lambda a, b: True)
+            user_index = BiMap.string_index(global_vocab(u for u, _ in pairs))
+            item_index = BiMap.string_index(global_vocab(i for _, i in pairs))
+        else:
+            user_index = BiMap.string_index(u for u, _ in pairs)
+            item_index = BiMap.string_index(i for _, i in pairs)
+        n = len(pairs)
+        rows = np.fromiter((user_index[u] for u, _ in pairs), np.int64, n)
+        cols = np.fromiter((item_index[i] for _, i in pairs), np.int64, n)
+        seen: dict[str, set] = {}
+        for u, i in pairs:
+            seen.setdefault(u, set()).add(i)
+        return TrainingData(rows, cols, user_index, item_index, seen)
+
+
+# ----------------------------------------------------------------- algorithm
+@dataclasses.dataclass(frozen=True)
+class TwoTowerParams(Params):
+    embedding_dim: int = 32
+    batch_size: int = 256
+    epochs: int = 5
+    learning_rate: float = 0.05
+    temperature: float = 0.1
+    seed: int = 0
+    json_aliases = {
+        "embeddingDim": "embedding_dim",
+        "batchSize": "batch_size",
+        "learningRate": "learning_rate",
+    }
+
+
+@dataclasses.dataclass
+class TwoTowerServingModel:
+    user_vecs: Any  # [U, D] L2-normalized
+    item_vecs: Any  # [I, D] L2-normalized
+    user_index: BiMap
+    item_index: BiMap
+    seen: dict
+    loss_history: tuple = ()
+
+
+class TwoTowerAlgorithm(JaxAlgorithm):
+    params_class = TwoTowerParams
+    query_class = Query
+
+    def __init__(self, params: TwoTowerParams):
+        super().__init__(params)
+
+    def train(self, ctx: WorkflowContext, pd: TrainingData) -> TwoTowerServingModel:
+        p = self.params
+        model = train_two_tower(
+            pd.rows,
+            pd.cols,
+            num_users=len(pd.user_index),
+            num_items=len(pd.item_index),
+            config=TwoTowerConfig(
+                dim=p.embedding_dim,
+                batch_size=p.batch_size,
+                epochs=p.epochs,
+                learning_rate=p.learning_rate,
+                temperature=p.temperature,
+                seed=p.seed,
+            ),
+            mesh=ctx.mesh,
+        )
+        return TwoTowerServingModel(
+            user_vecs=model.user_vecs,
+            item_vecs=model.item_vecs,
+            user_index=pd.user_index,
+            item_index=pd.item_index,
+            seen=pd.seen,
+            loss_history=model.loss_history,
+        )
+
+    def prepare_model_for_serving(
+        self, model: TwoTowerServingModel
+    ) -> TwoTowerServingModel:
+        model.user_vecs = np.ascontiguousarray(model.user_vecs)
+        model.item_vecs = np.ascontiguousarray(model.item_vecs)
+        if len(model.user_index):
+            self.predict(model, Query(user=model.user_index.keys()[0], num=4))
+        return model
+
+    def predict(self, model: TwoTowerServingModel, query: Query) -> PredictedResult:
+        uidx = model.user_index.get(query.user)
+        if uidx is None or int(query.num) <= 0:
+            return PredictedResult(())
+        seen = model.seen.get(query.user, ())
+        k = min(int(query.num) + len(seen), len(model.item_index))
+        if k <= 0:
+            return PredictedResult(())
+        scores = model.item_vecs @ np.asarray(model.user_vecs[uidx])
+        part = np.argpartition(scores, -k)[-k:]
+        top = part[np.argsort(scores[part])[::-1]]
+        out = []
+        for i in top:
+            item = model.item_index.inverse(int(i))
+            if item in seen:
+                continue
+            out.append(ItemScore(item=item, score=float(scores[i])))
+            if len(out) >= int(query.num):
+                break
+        return PredictedResult(tuple(out))
+
+
+class RecallAtK(OptionAverageMetric):
+    """Fraction of held-out positives recovered in the top-k."""
+
+    def __init__(self, k: int = 10):
+        self.k = k
+
+    def header(self) -> str:
+        return f"Recall@{self.k}"
+
+    def calculate_unit(self, query, predicted: PredictedResult, actual) -> float | None:
+        positives = set(actual)
+        if not positives:
+            return None
+        top = {s.item for s in predicted.item_scores[: self.k]}
+        return len(top & positives) / len(positives)
+
+
+def engine_factory() -> Engine:
+    return Engine(
+        datasource_class=TwoTowerDataSource,
+        preparator_class=IdentityPreparator,
+        algorithms_class_map={"twotower": TwoTowerAlgorithm},
+        serving_class=FirstServing,
+    )
