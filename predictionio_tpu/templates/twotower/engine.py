@@ -90,7 +90,12 @@ class PredictedResult:
 class DataSourceParams(Params):
     app_name: str = ""
     event_names: Sequence[str] = ("view", "rate", "buy", "like")
-    json_aliases = {"appName": "app_name", "eventNames": "event_names"}
+    eval_k: int = 2
+    json_aliases = {
+        "appName": "app_name",
+        "eventNames": "event_names",
+        "evalK": "eval_k",
+    }
 
 
 @dataclasses.dataclass
@@ -112,10 +117,13 @@ class TwoTowerDataSource(DataSource):
     def __init__(self, params: DataSourceParams):
         super().__init__(params)
 
-    def read_training(self, ctx: WorkflowContext) -> TrainingData:
+    def _read_pairs(self, ctx: WorkflowContext) -> list:
+        """Sorted distinct (user, item) pairs — the GLOBAL set on every
+        host. Training batches are replicated across a multi-host job and
+        the saved model's seen-filter must cover every user, so a
+        partitioned (per-host) merge would be incoherent; pairs are two
+        ids each, small next to the raw events they dedup."""
         p = self.params
-        # training consumes distinct (user, item) PAIRS — in-batch softmax
-        # has no per-pair weight, so a set (not counts) is the right shape
         pairs: dict[tuple[str, str], bool] = {}
         for e in PEventStore.find(
             app_name=p.app_name,
@@ -127,15 +135,18 @@ class TwoTowerDataSource(DataSource):
                 continue
             pairs[(e.entity_id, e.target_entity_id)] = True
         if ctx.num_hosts > 1:
-            from predictionio_tpu.parallel.exchange import global_vocab, merge_keyed
+            from predictionio_tpu.parallel.exchange import allgather_objects
 
-            # set-union across hosts: duplicates collapse to one pair
-            pairs = merge_keyed(pairs, combine=lambda a, b: True)
-            user_index = BiMap.string_index(global_vocab(u for u, _ in pairs))
-            item_index = BiMap.string_index(global_vocab(i for _, i in pairs))
-        else:
-            user_index = BiMap.string_index(u for u, _ in pairs)
-            item_index = BiMap.string_index(i for _, i in pairs)
+            merged = set()
+            for contrib in allgather_objects(sorted(pairs)):
+                merged.update(tuple(pr) for pr in contrib)
+            return sorted(merged)
+        return sorted(pairs)
+
+    @staticmethod
+    def _to_training_data(pairs: Sequence) -> TrainingData:
+        user_index = BiMap.string_index(sorted({u for u, _ in pairs}))
+        item_index = BiMap.string_index(sorted({i for _, i in pairs}))
         n = len(pairs)
         rows = np.fromiter((user_index[u] for u, _ in pairs), np.int64, n)
         cols = np.fromiter((item_index[i] for _, i in pairs), np.int64, n)
@@ -143,6 +154,44 @@ class TwoTowerDataSource(DataSource):
         for u, i in pairs:
             seen.setdefault(u, set()).add(i)
         return TrainingData(rows, cols, user_index, item_index, seen)
+
+    def read_training(self, ctx: WorkflowContext) -> TrainingData:
+        # training consumes distinct (user, item) PAIRS — in-batch softmax
+        # has no per-pair weight, so a set (not counts) is the right shape
+        return self._to_training_data(self._read_pairs(ctx))
+
+    def read_eval(self, ctx: WorkflowContext):
+        """K-fold split by stable hash of (user, item): train on k-1
+        folds, query each user with held-out interactions for the full
+        ranking, actual = the held-out item ids (consumed by
+        :class:`RecallAtK`). Mirrors the Recommendation template's
+        ``readEval`` shape."""
+        import zlib
+
+        pairs = self._read_pairs(ctx)
+        k = max(2, self.params.eval_k)
+
+        def fold_of(u: str, i: str) -> int:
+            return zlib.crc32(f"{u}\x00{i}".encode()) % k
+
+        folds = []
+        for fold in range(k):
+            train = [pr for pr in pairs if fold_of(*pr) != fold]
+            held = [pr for pr in pairs if fold_of(*pr) == fold]
+            td = self._to_training_data(train)
+            by_user: dict[str, list] = {}
+            for u, i in held:
+                # only users the fold's model knows can be queried
+                if u in td.user_index:
+                    by_user.setdefault(u, []).append(i)
+            num_items = len(td.item_index)
+            qa = [
+                (Query(user=u, num=num_items), tuple(items))
+                for u, items in by_user.items()
+                if items
+            ]
+            folds.append((td, {"fold": fold}, qa))
+        return folds
 
 
 # ----------------------------------------------------------------- algorithm
