@@ -162,6 +162,15 @@ def build_parser() -> argparse.ArgumentParser:
     adm.add_argument("--port", type=int, default=7071)
     add_ssl_flags(adm)
 
+    # ---- template
+    tpl = sub.add_parser("template", help="built-in engine templates")
+    tpl_sub = tpl.add_subparsers(dest="template_command", required=True)
+    tpl_sub.add_parser("list")
+    tpl_get = tpl_sub.add_parser("get")
+    tpl_get.add_argument("name")
+    tpl_get.add_argument("directory")
+    tpl_get.add_argument("--appname", default="MyApp")
+
     # ---- storageserver
     ss = sub.add_parser(
         "storageserver",
@@ -377,6 +386,11 @@ def main(argv: list[str] | None = None) -> int:
                 AdminService().dispatch, args.ip, args.port,
                 ssl_context=_ssl_from_args(args),
             )
+        elif cmd == "template":
+            if args.template_command == "list":
+                commands.template_list()
+            elif args.template_command == "get":
+                commands.template_get(args.name, args.directory, args.appname)
         elif cmd == "storageserver":
             from predictionio_tpu.api.http import serve
             from predictionio_tpu.data.storage.remote import StorageRpcService

@@ -130,3 +130,45 @@ class TestConsoleEntryPoint:
         lines = [json.loads(l) for l in results.read_text().splitlines()]
         # model = 22 -> prediction = 22 + q
         assert [l["prediction"] for l in lines] == [23, 24]
+
+
+class TestTemplateCommands:
+    def test_template_list(self, quiet):
+        templates = commands.template_list(out=quiet.append)
+        assert "recommendation" in templates and "twotower" in templates
+        assert any("engine_factory" in line for line in quiet)
+
+    def test_template_get_scaffolds_trainable_engine(self, tmp_path, quiet):
+        path = commands.template_get(
+            "recommendation", str(tmp_path / "eng"), app_name="tplapp",
+            out=quiet.append,
+        )
+        variant = json.load(open(path))
+        assert variant["engineFactory"].endswith(":engine_factory")
+        assert variant["datasource"]["params"]["appName"] == "tplapp"
+        # the scaffold must resolve to a real engine
+        from predictionio_tpu.workflow import load_engine_variant
+
+        assert load_engine_variant(variant).build_engine() is not None
+        with pytest.raises(ValueError, match="refusing to overwrite"):
+            commands.template_get("recommendation", str(tmp_path / "eng"),
+                                  out=quiet.append)
+
+    def test_template_get_unknown(self, quiet):
+        with pytest.raises(ValueError, match="Unknown template"):
+            commands.template_get("nope", "/tmp/x", out=quiet.append)
+
+    def test_every_builtin_scaffold_binds(self, tmp_path):
+        """Every scaffolded engine.json must resolve its factory AND bind
+        its algorithm names/params — a bad name would only fail at
+        train time otherwise."""
+        from predictionio_tpu.workflow import load_engine_variant
+
+        for name in commands.BUILTIN_TEMPLATES:
+            path = commands.template_get(
+                name, str(tmp_path / name), out=lambda _: None
+            )
+            variant = load_engine_variant(json.load(open(path)))
+            engine = variant.build_engine()
+            ep = variant.engine_params(engine)  # binds params dataclasses
+            assert ep.algorithms, name
