@@ -267,3 +267,68 @@ def test_two_process_template_coherence(tmp_path):
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"worker {i}:\n{out}"
         assert f"MULTIHOST-TEMPLATE-OK {i}" in out
+
+
+DEAD_PEER_WORKER = """
+import os, sys, time
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, %(repo)r)
+from predictionio_tpu.parallel import initialize_from_env
+assert initialize_from_env() is True
+from predictionio_tpu.parallel.exchange import allgather_objects, pairwise_exchange
+
+me = jax.process_index()
+if me == 1:
+    # rendezvous with a dead address, then vanish: the peer must FAIL
+    # CLEANLY, not hang (the reference relies on Spark task retry here;
+    # our contract is a prompt, catchable error)
+    allgather_objects(("127.0.0.1", 1))  # port 1: nothing listens
+    print("DEADPEER-OK", me)
+    sys.exit(0)
+t0 = time.time()
+try:
+    pairwise_exchange([b"a", b"b"], timeout=15.0)
+except Exception as e:
+    elapsed = time.time() - t0
+    assert elapsed < 60, f"took {elapsed}s - hang instead of clean failure"
+    print("DEADPEER-OK", me)
+    sys.exit(0)
+print("DEADPEER-FAIL no error raised", me)
+sys.exit(1)
+"""
+
+
+def test_dead_peer_fails_cleanly_not_hangs(tmp_path):
+    """A peer that dies after rendezvous must surface as a prompt error
+    on the survivor, not a distributed-timeout hang."""
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    code = DEAD_PEER_WORKER % {"repo": _REPO}
+    env = {
+        **os.environ,
+        "PIO_COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
+        "PIO_NUM_PROCESSES": "2",
+    }
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", code],
+            env={**env, "PIO_PROCESS_ID": str(i)},
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=180)
+        outs.append(out)
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc {i} rc={p.returncode}\n{out}"
+        assert "DEADPEER-OK" in out, f"proc {i}:\n{out}"
