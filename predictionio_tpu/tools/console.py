@@ -215,6 +215,40 @@ def _parse_mesh(spec: str):
     )
 
 
+def _setup_compilation_cache() -> None:
+    """Persist compiled XLA programs across runs: a repeat ``pio train``
+    on the same shapes skips the (tens-of-seconds, possibly remote)
+    compile entirely. ``PIO_COMPILATION_CACHE_DIR=0`` disables; default
+    is ``<PIO_FS_BASEDIR>/jax_cache``. Costs no jax import of its own:
+    env vars configure a not-yet-imported jax lazily, and only an
+    already-imported jax (preloaded interpreters) gets config.update."""
+    explicit = os.environ.get("PIO_COMPILATION_CACHE_DIR")
+    if explicit == "0":
+        return
+    if explicit:
+        cache_dir = os.path.expanduser(explicit)
+    else:
+        from predictionio_tpu.data.storage import Storage
+
+        cache_dir = os.path.join(Storage.base_dir(), "jax_cache")
+    if "jax" in sys.modules:
+        jax = sys.modules["jax"]
+        try:
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        except Exception as e:
+            if explicit:
+                print(
+                    f"WARNING: could not enable the compilation cache at "
+                    f"{cache_dir}: {e}",
+                    file=sys.stderr,
+                )
+    else:
+        # jax reads these at import; operator-set JAX_* values win
+        os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", cache_dir)
+        os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1.0")
+
+
 def _ssl_from_args(args):
     """TLS context from --cert/--key flags, falling back to the
     PIO_SSL_CERT / PIO_SSL_KEY env vars; None = plain http. A
@@ -241,6 +275,7 @@ def main(argv: list[str] | None = None) -> int:
         import jax
 
         jax.config.update("jax_platforms", platform_override)
+    _setup_compilation_cache()
     args = build_parser().parse_args(argv)
     cmd = args.command
     try:
