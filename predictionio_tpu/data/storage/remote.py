@@ -658,6 +658,12 @@ def _encode_result(v: Any) -> Any:
     enc = _ENCODERS.get(type(v))
     if enc is not None:
         return enc(v)
+    if isinstance(v, Mapping):
+        # refuse rather than let the generic-iterable branch silently
+        # serialize a mapping as its keys (advisor r3)
+        raise StorageError(
+            "cannot serialize Mapping result — add an explicit encoder"
+        )
     if isinstance(v, (list, tuple)) or hasattr(v, "__iter__"):
         return [_encode_result(x) for x in v]
     raise StorageError(f"cannot serialize result of type {type(v).__name__}")

@@ -11,7 +11,9 @@ driver is ``localfs`` hardened for concurrent multi-host use:
 * data and directory are fsync'd before the atomic rename, so a reader
   on another host never observes a torn blob through close-to-open
   consistency (NFS) after the rename is visible;
-* reads retry once on a concurrent replace.
+* reads retry (3 attempts) across a concurrent replace and raise
+  ``StorageError`` — never a false "absent" — if the path persists but
+  every open raced a replacement.
 
 Config::
 
@@ -75,14 +77,19 @@ class _SharedFsModels(_FsModels):
 
     def get(self, model_id: str) -> Model | None:
         path = self._path(model_id)
-        for _ in range(2):  # retry once across a concurrent os.replace
+        for _ in range(3):  # retry across a concurrent os.replace
             try:
                 with open(path, "rb") as f:
                     return Model(id=model_id, models=f.read())
             except FileNotFoundError:
                 if not os.path.exists(path):
                     return None
-        return None
+        # never misreport an existing model as absent (advisor r3): the
+        # path still exists, yet every open raced a concurrent replace
+        raise StorageError(
+            f"model {model_id!r} exists at {path} but could not be opened "
+            "after repeated concurrent replacements"
+        )
 
     def delete(self, model_id: str) -> bool:
         try:

@@ -27,6 +27,7 @@ rating RDD (reference: MLlib ALS block partitioning reached via
 
 from __future__ import annotations
 
+import logging
 import os
 import pickle
 import socket
@@ -35,6 +36,8 @@ import threading
 from typing import Any, Sequence
 
 import numpy as np
+
+logger = logging.getLogger(__name__)
 
 __all__ = [
     "allgather_bytes",
@@ -102,7 +105,14 @@ def allgather_objects(obj: Any) -> list[Any]:
 # Point-to-point transport
 # ---------------------------------------------------------------------------
 
-_HDR = struct.Struct("<iq")  # (sender rank, payload length)
+#: header = (sender rank, payload length, receiver's 16-byte exchange token).
+#: The token is generated fresh per exchange by each receiver and distributed
+#: through the rendezvous allgather, which rides the trusted jax.distributed
+#: channel — so only real peers can present it. Without it, anything able to
+#: reach the ephemeral port during the exchange window could feed
+#: ``pickle.loads`` an arbitrary payload (advisor r3 medium finding).
+_HDR = struct.Struct("<iq16s")
+_TOKEN_LEN = 16
 
 
 def _recv_exact(conn: socket.socket, n: int) -> bytes:
@@ -162,20 +172,23 @@ def pairwise_exchange(payloads: Sequence[bytes], timeout: float = 300.0) -> list
     if len(payloads) != P:
         raise ValueError(f"need {P} payloads, got {len(payloads)}")
 
+    import secrets
+
     server = socket.create_server(("0.0.0.0", 0), backlog=P)
     server.settimeout(timeout)
     port = server.getsockname()[1]
-    addrs = allgather_objects((_p2p_host(), port))
+    my_token = secrets.token_bytes(_TOKEN_LEN)
+    addrs = allgather_objects((_p2p_host(), port, my_token))
 
     results: list = [None] * P
     results[me] = payloads[me]
-    errors: list = []
+    filled = threading.Event()  # all P-1 peer payloads received
 
-    def handle(conn: socket.socket) -> None:
+    def handle(conn: socket.socket, peer: Any) -> None:
         try:
             with conn:
                 conn.settimeout(timeout)
-                rank, length = _HDR.unpack(_recv_exact(conn, _HDR.size))
+                rank, length, token = _HDR.unpack(_recv_exact(conn, _HDR.size))
                 # reject garbage/stray connections: an unvalidated rank
                 # (esp. negative) would silently overwrite a peer's slot,
                 # and an absurd length would allocate unbounded memory
@@ -186,23 +199,47 @@ def pairwise_exchange(payloads: Sequence[bytes], timeout: float = 300.0) -> list
                     raise ConnectionError(
                         f"invalid peer header (rank={rank}, len={length})"
                     )
+                if not secrets.compare_digest(token, my_token):
+                    raise ConnectionError(
+                        f"bad exchange token from claimed rank {rank} — "
+                        "refusing payload (untrusted connector?)"
+                    )
                 results[rank] = _recv_exact(conn, length)
                 _count("p2p_received", length)
-        except Exception as e:  # surfaced after join
-            errors.append(e)
+                if all(r is not None for r in results):
+                    filled.set()
+        except Exception as e:
+            # a stray or untrusted connection must not burn the exchange:
+            # drop it and keep listening — completion is "every peer
+            # reported", not "P-1 accepts"; a genuinely lost peer
+            # surfaces as a missing slot at the deadline
+            logger.warning("dropped p2p connection from %s: %s", peer, e)
 
     def acceptor() -> None:
+        import time
+
+        deadline = time.monotonic() + timeout
+        server.settimeout(1.0)
         handlers = []
-        try:
-            for _ in range(P - 1):
-                conn, _ = server.accept()
-                t = threading.Thread(target=handle, args=(conn,), daemon=True)
-                t.start()
-                handlers.append(t)
-        except Exception as e:
-            errors.append(e)
+        while not filled.is_set() and time.monotonic() < deadline:
+            try:
+                conn, addr = server.accept()
+            except TimeoutError:
+                continue
+            except OSError:
+                break  # listener closed underneath us
+            t = threading.Thread(target=handle, args=(conn, addr), daemon=True)
+            t.start()
+            handlers.append(t)
         for t in handlers:
-            t.join(timeout=timeout)
+            # once every peer has reported, any handler still running is a
+            # stray connection stalling in its header read — don't let it
+            # hold a successful exchange hostage for the full timeout
+            t.join(
+                timeout=0.1
+                if filled.is_set()
+                else max(0.0, deadline - time.monotonic()) + 1.0
+            )
 
     acc = threading.Thread(target=acceptor, daemon=True)
     acc.start()
@@ -211,19 +248,17 @@ def pairwise_exchange(payloads: Sequence[bytes], timeout: float = 300.0) -> list
         # so no single host absorbs all P-1 connections at once
         for offset in range(1, P):
             dst = (me + offset) % P
-            host, dport = addrs[dst]
+            host, dport, dst_token = addrs[dst]
             with socket.create_connection((host, dport), timeout=timeout) as s:
                 data = payloads[dst]
-                s.sendall(_HDR.pack(me, len(data)))
+                s.sendall(_HDR.pack(me, len(data), dst_token))
                 s.sendall(data)
                 _count("p2p_sent", len(data))
-        acc.join(timeout=timeout)
+        acc.join(timeout=timeout + 2.0)
     finally:
         # always reclaim the listener — a failed send must not leave the
         # rendezvous socket open with the acceptor still feeding it
         server.close()
-    if errors:
-        raise RuntimeError(f"pairwise exchange failed: {errors[0]}") from errors[0]
     missing = [p for p in range(P) if results[p] is None]
     if missing:
         raise RuntimeError(

@@ -130,6 +130,11 @@ def build_parser() -> argparse.ArgumentParser:
         "--insecure", action="store_true",
         help="skip TLS certificate verification (self-signed deployments)",
     )
+    und.add_argument(
+        "--token", default=None,
+        help="deployment stop token (default: read from the basedir token "
+        "file written by `pio deploy` for this port)",
+    )
 
     # ---- eval
     ev = sub.add_parser("eval", help="run an evaluation sweep")
@@ -177,7 +182,11 @@ def build_parser() -> argparse.ArgumentParser:
         help="expose this host's storage backend over the network "
         "(server side of the TYPE=remote driver)",
     )
-    ss.add_argument("--ip", default="0.0.0.0")
+    ss.add_argument(
+        "--ip", default="127.0.0.1",
+        help="bind address; binding beyond loopback requires --secret "
+        "(the server grants read/write on apps, keys, events and models)",
+    )
     ss.add_argument("--port", type=int, default=7072)
     ss.add_argument(
         "--secret", default=None,
@@ -353,9 +362,13 @@ def main(argv: list[str] | None = None) -> int:
 
             def wire_stop(server):
                 # GET /stop answers first, then the server shuts down on a
-                # helper thread (shutdown() from a handler would deadlock)
+                # helper thread (shutdown() from a handler would deadlock).
+                # The stop token is written only after a successful bind so
+                # a failed re-deploy on a busy port cannot clobber the live
+                # deployment's token file.
                 import threading
 
+                service.stop_token = commands.write_stop_token(args.port)
                 service.stop_server = lambda: threading.Thread(
                     target=server.shutdown, daemon=True
                 ).start()
@@ -366,7 +379,9 @@ def main(argv: list[str] | None = None) -> int:
                 ssl_context=_ssl_from_args(args), ready_callback=wire_stop,
             )
         elif cmd == "undeploy":
-            commands.undeploy(args.ip, args.port, args.https, args.insecure)
+            commands.undeploy(
+                args.ip, args.port, args.https, args.insecure, token=args.token
+            )
         elif cmd == "eval":
             from predictionio_tpu.controller import local_context
             from predictionio_tpu.controller.evaluation import EngineParamsGenerator
@@ -431,6 +446,14 @@ def main(argv: list[str] | None = None) -> int:
             from predictionio_tpu.data.storage.remote import StorageRpcService
 
             secret = args.secret or os.environ.get("PIO_STORAGE_SERVER_SECRET")
+            loopback = args.ip.startswith("127.") or args.ip in ("localhost", "::1")
+            if not loopback and not secret:
+                raise SystemExit(
+                    "storageserver grants unauthenticated read/write of apps, "
+                    "access keys, events and model blobs; refusing to bind "
+                    f"non-loopback address {args.ip!r} without --secret / "
+                    "$PIO_STORAGE_SERVER_SECRET"
+                )
             print(f"Storage server is listening on {args.ip}:{args.port}")
             serve(
                 StorageRpcService(secret=secret).dispatch, args.ip, args.port,

@@ -42,6 +42,12 @@ class QueryServerError(RuntimeError):
     pass
 
 
+def _token_ok(presented: str, expected: str) -> bool:
+    import hmac
+
+    return hmac.compare_digest(str(presented), expected)
+
+
 class EngineServerPlugin:
     """Serving-side plugin (parity: ``core/workflow/EngineServerPlugin.scala``).
 
@@ -105,6 +111,10 @@ class QueryService:
         #: ``GET /stop`` to shut the HTTP server down (parity:
         #: CreateServer's stop route / `pio undeploy`)
         self.stop_server: Any = None
+        #: when set, ``GET /stop`` requires ``?token=<stop_token>``
+        #: (console deploy generates one and shares it with undeploy
+        #: via a basedir token file)
+        self.stop_token: str | None = None
         # one long-lived worker drains feedback posts — per-query threads
         # would grow unboundedly when the event server is slow
         self._feedback_queue: "queue.Queue | None" = None
@@ -292,7 +302,16 @@ class QueryService:
                 return Response(500, {"message": str(e)})
         if path == "/stop" and method == "GET":
             # parity: CreateServer's stop route; the transport sets
-            # stop_server so the response is written before shutdown
+            # stop_server so the response is written before shutdown.
+            # When stop_token is set (pio deploy always sets one), the
+            # caller must present it — otherwise anyone who can reach the
+            # port could shut down a production deployment (advisor r3).
+            if self.stop_token and not _token_ok(
+                params.get("token", ""), self.stop_token
+            ):
+                return Response(
+                    403, {"message": "Missing or invalid stop token."}
+                )
             if self.stop_server is None:
                 return Response(
                     501, {"message": "This deployment has no stop hook."}

@@ -285,7 +285,7 @@ if me == 1:
     # rendezvous with a dead address, then vanish: the peer must FAIL
     # CLEANLY, not hang (the reference relies on Spark task retry here;
     # our contract is a prompt, catchable error)
-    allgather_objects(("127.0.0.1", 1))  # port 1: nothing listens
+    allgather_objects(("127.0.0.1", 1, b"x" * 16))  # port 1: nothing listens
     print("DEADPEER-OK", me)
     sys.exit(0)
 t0 = time.time()
@@ -332,3 +332,70 @@ def test_dead_peer_fails_cleanly_not_hangs(tmp_path):
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"proc {i} rc={p.returncode}\n{out}"
         assert "DEADPEER-OK" in out, f"proc {i}:\n{out}"
+
+
+def test_rogue_connection_is_dropped_not_fatal(monkeypatch):
+    """An untrusted connector reaching the exchange port mid-window (the
+    advisor r3 pickle-RCE scenario) must be rejected by the token check
+    AND must not consume the exchange's accept budget: the real peers
+    still complete. Simulated in-process with two threads acting as ranks
+    0/1 via thread-local process identity."""
+    import socket
+    import struct
+    import threading
+
+    import jax
+
+    import predictionio_tpu.parallel.exchange as ex
+
+    tl = threading.local()
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(jax, "process_index", lambda: tl.rank)
+
+    store: dict = {}
+    barrier = threading.Barrier(2)
+    lock = threading.Lock()
+    rogue_done = threading.Event()
+
+    def fake_allgather(obj):
+        with lock:
+            store[tl.rank] = obj
+        barrier.wait()
+        out = [store[0], store[1]]
+        # hold BOTH ranks at the rendezvous until the rogue has hit rank
+        # 0's listener, guaranteeing the rogue lands inside the window
+        rogue_done.wait(timeout=20)
+        return out
+
+    monkeypatch.setattr(ex, "allgather_objects", fake_allgather)
+
+    results: dict = {}
+    errors: dict = {}
+
+    def run(rank, payloads):
+        tl.rank = rank
+        try:
+            results[rank] = ex.pairwise_exchange(payloads, timeout=20.0)
+        except Exception as e:  # surfaced in the main thread's asserts
+            errors[rank] = e
+
+    t0 = threading.Thread(target=run, args=(0, [b"keep0", b"zero->one"]))
+    t1 = threading.Thread(target=run, args=(1, [b"one->zero", b"keep1"]))
+    t0.start()
+    t1.start()
+    # wait for both ranks to publish (host, port, token), then attack rank 0
+    for _ in range(200):
+        with lock:
+            if len(store) == 2:
+                break
+        threading.Event().wait(0.05)
+    host, port, _token = store[0]
+    evil = b"evil pickle payload"
+    with socket.create_connection(("127.0.0.1", port), timeout=10) as s:
+        s.sendall(struct.pack("<iq16s", 1, len(evil), b"W" * 16) + evil)
+    rogue_done.set()
+    t0.join(timeout=30)
+    t1.join(timeout=30)
+    assert not errors, errors
+    assert results[0] == [b"keep0", b"one->zero"]
+    assert results[1] == [b"zero->one", b"keep1"]

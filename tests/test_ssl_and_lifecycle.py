@@ -5,6 +5,7 @@ GET /stop, `pio undeploy`, and the stop hook wiring."""
 import datetime as dt
 import json
 import ssl
+import urllib.error
 import urllib.request
 
 import pytest
@@ -215,3 +216,44 @@ class TestLifecycle:
 
         with pytest.raises(RuntimeError, match="Could not reach"):
             commands.undeploy("127.0.0.1", 1, out=lambda _: None)
+
+    def test_stop_token_gates_shutdown(self, trained_variant, tmp_path, monkeypatch):
+        """With a stop token set (pio deploy always sets one), GET /stop
+        without the token is 403 and the server stays up; `pio undeploy`
+        reads the token file and succeeds (advisor r3 low finding)."""
+        from predictionio_tpu.tools import commands
+        from predictionio_tpu.workflow.serving import QueryService
+
+        monkeypatch.setenv("PIO_FS_BASEDIR", str(tmp_path))
+        qs = QueryService(trained_variant)
+        server, thread = start_background(qs.dispatch)
+        qs.stop_server = server.shutdown
+        port = server.server_address[1]
+        qs.stop_token = commands.write_stop_token(port)
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _get(f"http://127.0.0.1:{port}/stop")
+            assert ei.value.code == 403
+            assert thread.is_alive()
+            # undeploy with a wrong token reports the refusal
+            with pytest.raises(RuntimeError, match="refused to stop"):
+                commands.undeploy(
+                    "127.0.0.1", port, token="wrong", out=lambda _: None
+                )
+            # default path: token read back from the basedir file
+            out = []
+            commands.undeploy("127.0.0.1", port, out=out.append)
+            assert "Undeployed" in out[0]
+            thread.join(timeout=10)
+            assert not thread.is_alive()
+        finally:
+            server.server_close()
+
+
+class TestStorageServerBindGuard:
+    def test_refuses_public_bind_without_secret(self, monkeypatch):
+        from predictionio_tpu.tools.console import main
+
+        monkeypatch.delenv("PIO_STORAGE_SERVER_SECRET", raising=False)
+        with pytest.raises(SystemExit, match="refusing to bind"):
+            main(["storageserver", "--ip", "0.0.0.0", "--port", "0"])
