@@ -301,6 +301,156 @@ def _cpu_baseline(rows, cols, vals, num_users, num_items, rank):
 
 
 # ---------------------------------------------------------------------------
+# Full product path: event store -> pio-train workflow -> model
+# (VERDICT r3 next-round #1 — the headline number must be the FRAMEWORK's,
+# not the kernel's)
+# ---------------------------------------------------------------------------
+
+
+def _bench_workflow(nnz: int, rank: int, iters: int) -> dict:
+    """Runs the reference's defining trace end to end at benchmark scale:
+    bulk-ingest ``nnz`` rating events into the columnar event store, then
+    ``run_train`` through the real Recommendation template (PEventStore
+    columnar scan -> vectorized dedup/BiMap -> train_als) with the model
+    persisted through the Models repo. Also measures the (per-event
+    Python) ``pio import`` JSONL path on a subsample for honesty about
+    the REST-shaped ingest rate."""
+    import json as _json
+    import tempfile
+
+    from predictionio_tpu.data.storage import Storage
+    from predictionio_tpu.data.storage.base import App
+    from predictionio_tpu.tools import commands
+    from predictionio_tpu.workflow import load_engine_variant, run_train
+    from predictionio_tpu.controller import local_context
+
+    tmp = tempfile.mkdtemp(prefix="pio-bench-events-")
+    Storage.configure(
+        {
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+            "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "COL",
+            "PIO_STORAGE_SOURCES_COL_TYPE": "columnar",
+            "PIO_STORAGE_SOURCES_COL_PATH": tmp,
+        }
+    )
+    try:
+        app_id = Storage.get_meta_data_apps().insert(App(id=0, name="wfbench"))
+        num_users = max(1000, int(nnz / 145))
+        num_items = max(500, int(nnz / 740))
+        rows, cols, vals = _make_workload(nnz, num_users, num_items, seed=5)
+        rng = np.random.default_rng(9)
+        t_us = (
+            1_600_000_000_000_000 + rng.integers(0, 10**9, nnz)
+        ).astype(np.int64)
+
+        # --- bulk columnar ingest (the sharded-writer path) ---------------
+        t0 = time.perf_counter()
+        Storage.get_p_events().write_columns(
+            app_id,
+            event="rate",
+            entity_type="user",
+            entity_codes=rows,
+            entity_vocab=np.asarray([str(i) for i in range(num_users)]),
+            target_entity_type="item",
+            target_codes=cols,
+            target_vocab=np.asarray([str(i) for i in range(num_items)]),
+            event_time_us=t_us,
+            props={"rating": vals.astype(np.float64)},
+        )
+        ingest_s = time.perf_counter() - t0
+
+        # --- `pio import` JSONL subsample (the REST-wire-shaped path) -----
+        sub = min(nnz, 200_000)
+        jsonl = os.path.join(tmp, "import-sample.jsonl")
+        with open(jsonl, "w") as f:
+            for k in range(sub):
+                f.write(
+                    _json.dumps(
+                        {
+                            "event": "rate",
+                            "entityType": "user",
+                            "entityId": str(int(rows[k])),
+                            "targetEntityType": "item",
+                            "targetEntityId": str(int(cols[k])),
+                            "properties": {"rating": float(vals[k])},
+                            "eventTime": "2021-06-01T00:00:00.000Z",
+                        }
+                    )
+                    + "\n"
+                )
+        t0 = time.perf_counter()
+        commands.import_events("wfbench", jsonl, out=lambda *_: None)
+        import_s = time.perf_counter() - t0
+        # the JSONL import landed `sub` extra events in the store; they
+        # participate in training (same events, duplicates dedup away)
+
+        # --- the real `pio train` trace ------------------------------------
+        variant = load_engine_variant(
+            {
+                "id": "wf-bench",
+                "version": "1",
+                "engineFactory": "predictionio_tpu.templates.recommendation:engine_factory",
+                "datasource": {"params": {"appName": "wfbench"}},
+                "algorithms": [
+                    {
+                        "name": "als",
+                        "params": {
+                            "rank": rank,
+                            "numIterations": iters,
+                            "lambda": 0.05,
+                            "seed": 7,
+                        },
+                    }
+                ],
+            }
+        )
+        ctx = local_context()
+
+        def timed_train():
+            t0 = time.perf_counter()
+            instance = run_train(variant, ctx)
+            wall = time.perf_counter() - t0
+            phases = _json.loads(instance.env.get("phase_timings", "{}"))
+            return wall, float(phases.get("read", 0.0)), float(
+                phases.get("train:als", 0.0)
+            )
+
+        # cold = first-ever run (pays one-time XLA compiles at these
+        # shapes); warm = the steady retrain (persistent compile cache +
+        # warm page cache) — the production `pio train` pattern
+        cold_wall, cold_read, cold_train = timed_train()
+        warm_wall, warm_read, warm_train = timed_train()
+        total = ingest_s + warm_wall
+        return {
+            "nnz": nnz,
+            "ingest_write_columns_seconds": round(ingest_s, 2),
+            "ingest_write_columns_events_per_sec": round(nnz / ingest_s, 1),
+            "import_jsonl_events_per_sec": round(sub / import_s, 1),
+            "workflow_train_wall_seconds": round(warm_wall, 2),
+            "phase_read_seconds": round(warm_read, 2),
+            "phase_train_seconds": round(warm_train, 2),
+            "cold_train_wall_seconds": round(cold_wall, 2),
+            "cold_phase_read_seconds": round(cold_read, 2),
+            "data_plane_fraction_of_train": round(
+                warm_read / max(warm_wall, 1e-9), 3
+            ),
+            "workflow_end_to_end_ratings_per_sec": round(
+                nnz * iters / warm_wall, 1
+            ),
+            "workflow_with_ingest_ratings_per_sec": round(
+                nnz * iters / total, 1
+            ),
+        }
+    finally:
+        Storage.configure(None)
+        import shutil
+
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+# ---------------------------------------------------------------------------
 # Serving latency over real HTTP (p50 target: < 10 ms, BASELINE.md)
 # ---------------------------------------------------------------------------
 
@@ -465,6 +615,13 @@ def main() -> None:
         "cluster the sweep ratio is ~vs_baseline/N assuming linear "
         "scaling (shuffle overhead makes real Spark sublinear)",
     }
+
+    if os.environ.get("BENCH_WORKFLOW", "1") != "0":
+        # the full product path at the same scale as the kernel bench
+        try:
+            detail["workflow"] = _bench_workflow(nnz, rank, iters)
+        except Exception as e:
+            detail["workflow"] = {"error": str(e)[:300]}
 
     if os.environ.get("BENCH_SERVING", "1") != "0":
         n_req = int(os.environ.get("BENCH_SERVING_REQUESTS", 1000))

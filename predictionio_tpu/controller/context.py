@@ -50,6 +50,12 @@ class WorkflowContext:
     num_hosts: int = 1
     batch: str = ""
     verbose: int = 0
+    #: previous trained model for THIS algorithm when the run is a warm
+    #: retrain (``pio train --warm-start``); set per-algorithm by
+    #: ``Engine.train``. Algorithms that support it seed their optimizer
+    #: state from it (SURVEY.md section 8.3 "incremental re-index" —
+    #: the reference gets cheap retrains from Spark RDD caching).
+    warm_model: Any = None
 
     # -- sharding helpers ---------------------------------------------------
     @property

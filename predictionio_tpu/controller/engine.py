@@ -171,13 +171,17 @@ class Engine:
         stop_after_read: bool = False,
         stop_after_prepare: bool = False,
         timings: dict | None = None,
+        warm_models: Sequence[tuple[str, Any]] | None = None,
     ) -> list[Any]:
         """Run DASE training; returns one model per algorithm
         (parity: ``object Engine.train``; the ``stop_after_*`` flags mirror
         ``WorkflowParams.stopAfterRead/Prepare``). When ``timings`` is a
         dict, per-phase wall-clock seconds are recorded into it
         (read/prepare/train:<name>) — the EngineInstance timing surface of
-        SURVEY.md section 6.1."""
+        SURVEY.md section 6.1. ``warm_models`` (``models_from_bytes`` of a
+        previous COMPLETED instance) hands each algorithm its predecessor
+        via ``ctx.warm_model`` for warm-started retrains."""
+        import dataclasses as _dc
         import time as _time
 
         def _timed(label: str, fn):
@@ -203,10 +207,19 @@ class Engine:
         models = []
         for i, (name, algo) in enumerate(algorithms):
             logger.info("Training algorithm '%s' (%s)", name, type(algo).__name__)
+            a_ctx = ctx
+            if (
+                warm_models is not None
+                and i < len(warm_models)
+                and warm_models[i][0] == name
+            ):
+                a_ctx = _dc.replace(ctx, warm_model=warm_models[i][1])
             key = f"train:{name}"
             if timings is not None and key in timings:
                 key = f"train:{name}#{i}"  # same algorithm listed twice
-            models.append(_timed(key, lambda a=algo: a.train_base(ctx, pd)))
+            models.append(
+                _timed(key, lambda a=algo, c=a_ctx: a.train_base(c, pd))
+            )
         return models
 
     # ------------------------------------------------------------------ eval
@@ -288,6 +301,36 @@ class Engine:
             entries.append(("pickle", model))
         return dumps_model(entries)
 
+    def models_from_bytes(
+        self,
+        engine_params: EngineParams,
+        instance_id: str,
+        model_blob: bytes,
+        algos: Sequence[tuple[str, Algorithm]] | None = None,
+    ) -> list[tuple[str, Any]]:
+        """Re-hydrate the raw trained models of a completed instance as
+        ``[(algorithm_name, model), ...]`` — no serving preparation. Used
+        by deploy (via :meth:`prepare_deploy`) and by warm retrains.
+        ``algos`` reuses a caller's already-constructed doers."""
+        if algos is None:
+            algos = self._make_algorithms(engine_params)
+        entries = loads_model(model_blob)
+        if len(entries) != len(algos):
+            raise ValueError(
+                f"Model blob holds {len(entries)} models but engine params "
+                f"declare {len(algos)} algorithms"
+            )
+        out = []
+        for (name, algo), (kind, payload) in zip(algos, entries):
+            if kind == "persistent":
+                model = load_persistent_model(payload, instance_id, algo.params)
+            elif kind == "pickle":
+                model = payload
+            else:
+                raise ValueError(f"Unknown model entry kind '{kind}'")
+            out.append((name, model))
+        return out
+
     def prepare_deploy(
         self,
         ctx: WorkflowContext,
@@ -300,22 +343,13 @@ class Engine:
         ``prepare_model_for_serving`` (device placement / jit warm-up)."""
         serving = create_doer(self.serving_class, engine_params.serving)
         algos = self._make_algorithms(engine_params)
-        entries = loads_model(model_blob)
-        if len(entries) != len(algos):
-            raise ValueError(
-                f"Model blob holds {len(entries)} models but engine params "
-                f"declare {len(algos)} algorithms"
-            )
-        pairs = []
-        for (name, algo), (kind, payload) in zip(algos, entries):
-            if kind == "persistent":
-                model = load_persistent_model(payload, instance_id, algo.params)
-            elif kind == "pickle":
-                model = payload
-            else:
-                raise ValueError(f"Unknown model entry kind '{kind}'")
-            pairs.append((algo, algo.prepare_model_for_serving(model)))
-        return serving, pairs
+        named = self.models_from_bytes(
+            engine_params, instance_id, model_blob, algos=algos
+        )
+        return serving, [
+            (algo, algo.prepare_model_for_serving(model))
+            for (name, algo), (_n, model) in zip(algos, named)
+        ]
 
 
 class SimpleEngine(Engine):

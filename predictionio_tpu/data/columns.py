@@ -1,0 +1,146 @@
+"""Columnar event batches — the bulk representation training reads.
+
+The reference feeds training from an ``RDD[Event]`` scan
+(``data/storage/PEvents.scala`` → ``storage/hbase/HBPEvents.find``); the
+per-record object stream is fine for Spark because the JVM amortizes it
+across a cluster. On a TPU host the analog is a **columnar batch**: dense
+numpy arrays with dictionary-encoded entity ids, which the input pipeline
+turns into device arrays without ever constructing 20M Python objects.
+
+:class:`EventColumns` is the exchange type of the ``PEvents.find_columns``
+SPI (``data/storage/base.py``): every driver can produce it (a universal
+event-iterator fallback lives on the ABC), and the ``columnar`` driver
+produces it at memcpy speed from its on-disk segments.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+__all__ = ["EventColumns", "columns_from_events", "encode_strings"]
+
+
+@dataclasses.dataclass
+class EventColumns:
+    """Dictionary-encoded event batch.
+
+    ``*_code`` arrays index into the matching ``*_vocab`` string arrays;
+    ``target_code == -1`` means the event has no target entity. ``prop``
+    (present when a property name was requested) is float32 with NaN for
+    rows where the property is absent or non-numeric — rows whose property
+    lives in a driver's non-columnar residue are still surfaced here.
+    Row order is deterministic per (driver, filters) but NOT globally
+    time-sorted; training consumers must not rely on event order beyond
+    what ``event_time_us`` itself provides.
+    """
+
+    event_code: np.ndarray  # int32 [N]
+    event_vocab: np.ndarray  # unicode [E]
+    entity_code: np.ndarray  # int32 [N]
+    entity_vocab: np.ndarray  # unicode [U]
+    target_code: np.ndarray  # int32 [N], -1 = no target entity
+    target_vocab: np.ndarray  # unicode [I]
+    event_time_us: np.ndarray  # int64 [N], UTC microseconds
+    prop: np.ndarray | None = None  # float32 [N], NaN = absent
+
+    def __len__(self) -> int:
+        return int(self.event_code.shape[0])
+
+    def compacted(self) -> "EventColumns":
+        """Re-index entity/target vocabularies to only the ids actually
+        referenced by the surviving rows (filters can orphan vocabulary
+        entries; a BiMap built from an uncompacted vocab would allocate
+        factor rows for entities that contributed no events)."""
+        used_e = np.unique(self.entity_code)
+        used_t = np.unique(self.target_code[self.target_code >= 0])
+        entity_code = np.searchsorted(used_e, self.entity_code).astype(np.int32)
+        target_code = np.full_like(self.target_code, -1)
+        has_t = self.target_code >= 0
+        target_code[has_t] = np.searchsorted(
+            used_t, self.target_code[has_t]
+        ).astype(np.int32)
+        return dataclasses.replace(
+            self,
+            entity_code=entity_code,
+            entity_vocab=self.entity_vocab[used_e],
+            target_code=target_code,
+            target_vocab=self.target_vocab[used_t],
+        )
+
+    def select(self, mask_or_index: np.ndarray) -> "EventColumns":
+        """Row subset (same vocabularies)."""
+        return dataclasses.replace(
+            self,
+            event_code=self.event_code[mask_or_index],
+            entity_code=self.entity_code[mask_or_index],
+            target_code=self.target_code[mask_or_index],
+            event_time_us=self.event_time_us[mask_or_index],
+            prop=None if self.prop is None else self.prop[mask_or_index],
+        )
+
+
+def encode_strings(values: list) -> tuple[np.ndarray, np.ndarray]:
+    """strings -> (codes int32, sorted vocab). None is not allowed here."""
+    arr = np.asarray(values, dtype=np.str_)
+    if arr.size == 0:
+        return np.zeros(0, np.int32), np.zeros(0, dtype="<U1")
+    vocab, codes = np.unique(arr, return_inverse=True)
+    return codes.astype(np.int32), vocab
+
+
+def columns_from_events(events, prop: str | None = None) -> EventColumns:
+    """Universal fallback: build an :class:`EventColumns` from an event
+    iterator. O(N) Python — correct everywhere, fast nowhere; drivers with
+    a columnar layout override ``find_columns`` instead of using this."""
+    ev_names: list[str] = []
+    ent_ids: list[str] = []
+    tgt_ids: list[str] = []
+    has_target: list[bool] = []
+    times: list[int] = []
+    props: list[float] = []
+    import datetime as _dt
+
+    utc = _dt.timezone.utc
+    for e in events:
+        ev_names.append(e.event)
+        ent_ids.append(e.entity_id)
+        if e.target_entity_id is None:
+            tgt_ids.append("")
+            has_target.append(False)
+        else:
+            tgt_ids.append(e.target_entity_id)
+            has_target.append(True)
+        t = e.event_time
+        if t.tzinfo is None:
+            t = t.replace(tzinfo=utc)
+        times.append(int(t.timestamp() * 1e6))
+        if prop is not None:
+            v = e.properties.opt(prop)
+            props.append(
+                float(v)
+                if isinstance(v, (int, float)) and not isinstance(v, bool)
+                else np.nan
+            )
+    event_code, event_vocab = encode_strings(ev_names)
+    entity_code, entity_vocab = encode_strings(ent_ids)
+    ht = np.asarray(has_target, dtype=bool)
+    n = len(ev_names)
+    if ht.any():
+        t_codes, target_vocab = encode_strings([t for t, h in zip(tgt_ids, ht) if h])
+        target_code = np.full(n, -1, np.int32)
+        target_code[ht] = t_codes
+    else:
+        target_code = np.full(n, -1, np.int32)
+        target_vocab = np.zeros(0, dtype="<U1")
+    return EventColumns(
+        event_code=event_code,
+        event_vocab=event_vocab,
+        entity_code=entity_code,
+        entity_vocab=entity_vocab,
+        target_code=target_code,
+        target_vocab=target_vocab,
+        event_time_us=np.asarray(times, dtype=np.int64),
+        prop=np.asarray(props, dtype=np.float32) if prop is not None else None,
+    )

@@ -374,6 +374,42 @@ class PEvents(abc.ABC):
     def delete(self, app_id: int, channel_id: int | None = None) -> None:
         """Delete all events of the stream (used by ``pio app data-delete``)."""
 
+    def find_columns(
+        self,
+        app_id: int,
+        channel_id: int | None = None,
+        start_time: _dt.datetime | None = None,
+        until_time: _dt.datetime | None = None,
+        entity_type: str | None = None,
+        event_names: Sequence[str] | None = None,
+        target_entity_type: str | None = None,
+        prop: str | None = None,
+        shard_index: int = 0,
+        num_shards: int = 1,
+    ):
+        """Columnar bulk scan: the same filters as :meth:`find`, landed as
+        dictionary-encoded numpy arrays (``data/columns.EventColumns``)
+        instead of an object stream — what the TPU input pipeline actually
+        wants at 10^7+ events. ``prop`` optionally extracts one numeric
+        property as a float column (NaN = absent).
+
+        This default adapts :meth:`find` row by row, so every driver is
+        columnar-capable; drivers with a native columnar layout override
+        it with an array-speed implementation.
+        """
+        from predictionio_tpu.data.columns import columns_from_events
+
+        return columns_from_events(
+            self.find(
+                app_id, channel_id,
+                start_time=start_time, until_time=until_time,
+                entity_type=entity_type, event_names=event_names,
+                target_entity_type=target_entity_type,
+                shard_index=shard_index, num_shards=num_shards,
+            ),
+            prop=prop,
+        )
+
 
 class BaseStorageClient(abc.ABC):
     """A connected driver instance (parity: ``BaseStorageClient.scala``).

@@ -46,6 +46,7 @@ _BUILTIN_DRIVERS = {
     "localfs": "predictionio_tpu.data.storage.localfs",
     "remote": "predictionio_tpu.data.storage.remote",
     "sharedfs": "predictionio_tpu.data.storage.sharedfs",
+    "columnar": "predictionio_tpu.data.storage.columnar",
 }
 
 

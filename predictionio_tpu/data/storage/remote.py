@@ -21,10 +21,15 @@ Config (client)::
 The wire format is one POST ``/rpc`` per repository call:
 ``{"repo": "apps", "method": "insert", "args": {...}}`` →
 ``{"result": ...}`` or ``{"error": "...", "kind": "storage"}``. Entities
-travel as JSON dicts (datetimes ISO-8601, model blobs base64); event
-scans return the full result list — the bulk training read path is
-expected to go through sharded export files at scale, exactly as the
-reference goes through HBase scans rather than the metadata API.
+travel as JSON dicts (datetimes ISO-8601, model blobs base64). Event
+scans are **paginated**: the client iterates ``find_page`` (offset
+cursor, ``PIO_REMOTE_FIND_PAGE`` events per response, default 20000) so
+neither side ever materializes an unbounded result list (advisor/VERDICT
+r3); it falls back to the legacy single-response ``find`` when the
+server predates pagination. Offset cursors re-scan on the server (the
+reference's HBase scanner keeps a server-side cursor instead) and are
+not snapshot-isolated across pages — the bulk training read path at real
+scale belongs on sharded/columnar local files either way.
 """
 
 from __future__ import annotations
@@ -454,6 +459,33 @@ class _RemoteModels(ModelsRepo):
         return bool(self._rpc.call("models", "delete", {"model_id": model_id}))
 
 
+def _paged_find(rpc: "_Rpc", role: str, args: dict) -> Iterator[Event]:
+    """Iterate a remote event scan page by page (offset cursor). Falls
+    back to the legacy unpaginated ``find`` on servers that predate
+    ``find_page``."""
+    import os
+
+    page_limit = int(os.environ.get("PIO_REMOTE_FIND_PAGE", "20000"))
+    offset = 0
+    while True:
+        try:
+            page = rpc.call(
+                role, "find_page",
+                {**args, "page_limit": page_limit, "offset": offset},
+            )
+        except StorageError as e:
+            if offset == 0 and "unknown method" in str(e):
+                for d in rpc.call(role, "find", args):
+                    yield _event_from_wire(d)
+                return
+            raise
+        for d in page["items"]:
+            yield _event_from_wire(d)
+        if page.get("next_offset") is None:
+            return
+        offset = int(page["next_offset"])
+
+
 class _RemoteLEvents(LEvents):
     def __init__(self, rpc: _Rpc):
         self._rpc = rpc
@@ -530,8 +562,7 @@ class _RemoteLEvents(LEvents):
                 event_names, target_entity_type, target_entity_id,
             )
         )
-        for d in self._rpc.call("l_events", "find", args):
-            yield _event_from_wire(d)
+        yield from _paged_find(self._rpc, "l_events", args)
 
 
 class _RemotePEvents(PEvents):
@@ -563,8 +594,7 @@ class _RemotePEvents(PEvents):
                 event_names, target_entity_type, target_entity_id,
             )
         )
-        for d in self._rpc.call("p_events", "find", args):
-            yield _event_from_wire(d)
+        yield from _paged_find(self._rpc, "p_events", args)
 
     def write(
         self, events: Iterable[Event], app_id: int, channel_id: int | None = None
@@ -701,10 +731,10 @@ class StorageRpcService:
         "l_events": frozenset(
             (
                 "init", "remove", "insert", "insert_batch", "get",
-                "delete", "find",
+                "delete", "find", "find_page",
             )
         ),
-        "p_events": frozenset(("find", "write", "delete")),
+        "p_events": frozenset(("find", "find_page", "write", "delete")),
     }
     _ROLES = tuple(_METHODS)
 
@@ -737,7 +767,9 @@ class StorageRpcService:
         if method not in self._METHODS.get(role, frozenset()):
             raise StorageError(f"unknown method '{role}.{method}'")
         repo = self._repo(role)
-        fn = getattr(repo, method)
+        # find_page is a server-layer verb over the repo's find iterator,
+        # not an SPI method — resolved after arg decoding below
+        fn = None if method == "find_page" else getattr(repo, method)
         kwargs = dict(args)
         # decode typed arguments
         ent = _ENTITY_ARGS.get((role, method))
@@ -756,7 +788,35 @@ class StorageRpcService:
             for tkey in ("start_time", "until_time"):
                 if tkey in kwargs:
                     kwargs[tkey] = _dt_from(kwargs[tkey])
+            if method == "find_page":
+                return self._find_page(repo, kwargs)
         return _encode_result(fn(**kwargs))
+
+    @staticmethod
+    def _find_page(repo: Any, kwargs: dict) -> dict:
+        """One bounded page of a scan: islice the repo's find iterator at
+        an offset cursor. Stateless (each page re-scans up to the offset)
+        so the server holds no per-client cursors; ``next_offset`` is
+        null on the final page."""
+        import itertools
+
+        try:
+            page_limit = int(kwargs.pop("page_limit"))
+            offset = int(kwargs.pop("offset"))
+        except (KeyError, TypeError, ValueError) as e:
+            raise StorageError(f"find_page needs page_limit/offset: {e}") from e
+        if not (0 < page_limit <= 1_000_000) or offset < 0:
+            raise StorageError(
+                f"invalid page (page_limit={page_limit}, offset={offset})"
+            )
+        items = list(
+            itertools.islice(repo.find(**kwargs), offset, offset + page_limit + 1)
+        )
+        has_more = len(items) > page_limit
+        return {
+            "items": [_event_to_wire(e) for e in items[:page_limit]],
+            "next_offset": offset + page_limit if has_more else None,
+        }
 
     # -- http dispatch (predictionio_tpu.api.http protocol) -----------------
     def dispatch(

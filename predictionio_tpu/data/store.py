@@ -67,6 +67,33 @@ class _PEventStore:
             shard_index=shard_index, num_shards=num_shards,
         )
 
+    def find_columns(
+        self,
+        app_name: str,
+        channel_name: str | None = None,
+        start_time: _dt.datetime | None = None,
+        until_time: _dt.datetime | None = None,
+        entity_type: str | None = None,
+        event_names: Sequence[str] | None = None,
+        target_entity_type: str | None = None,
+        prop: str | None = None,
+        shard_index: int = 0,
+        num_shards: int = 1,
+    ):
+        """Columnar bulk scan (``data/columns.EventColumns``): the same
+        filters as :meth:`find`, landed as dictionary-encoded numpy
+        arrays. Every driver supports it (the base SPI adapts the event
+        iterator); the ``columnar`` driver serves it at array speed —
+        this is the path a 10^7-event ``pio train`` reads through."""
+        app_id, channel_id = resolve_app(app_name, channel_name)
+        return Storage.get_p_events().find_columns(
+            app_id, channel_id,
+            start_time=start_time, until_time=until_time,
+            entity_type=entity_type, event_names=event_names,
+            target_entity_type=target_entity_type, prop=prop,
+            shard_index=shard_index, num_shards=num_shards,
+        )
+
     def aggregate_properties(
         self,
         app_name: str,

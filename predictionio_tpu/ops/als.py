@@ -1165,6 +1165,8 @@ def train_als(
     mesh: Mesh | None = None,
     data_axis: str = "data",
     model_axis: str = "model",
+    init_user: np.ndarray | None = None,
+    init_item: np.ndarray | None = None,
 ) -> ALSFactors:
     """Train factor matrices from COO ratings.
 
@@ -1173,6 +1175,11 @@ def train_als(
     re-partitioned by row through a bounded-memory exchange — per-host
     memory stays O(nnz / num_hosts) (see :func:`_multihost_bucketed`);
     without a mesh they are all-gathered (legacy replicated fallback).
+
+    ``init_user``/``init_item`` (``[num_users, K]`` / ``[num_items, K]``)
+    seed the factors instead of the random draw — the warm-retrain path
+    (``pio train --warm-start``). Unrated rows are still zeroed, and a
+    checkpoint resume (``config.checkpoint_dir``) takes precedence.
 
     Returns host-strippable ``ALSFactors`` with the sentinel rows removed:
     ``user [num_users, K]``, ``item [num_items, K]``.
@@ -1312,8 +1319,24 @@ def train_als(
     i_mask = np.append(i_rated, False)[:, None]
     # draw at the canonical (num_rows+1) shape so the init — and therefore
     # the trained factors — are identical across mesh shapes, then zero-pad
-    uf = jnp.abs(jax.random.normal(key_u, (num_users + 1, rank), jnp.float32)) * scale
-    vf = jnp.abs(jax.random.normal(key_i, (num_items + 1, rank), jnp.float32)) * scale
+    def _seed_table(key, init, num_rows):
+        if init is None:
+            return (
+                jnp.abs(jax.random.normal(key, (num_rows + 1, rank), jnp.float32))
+                * scale
+            )
+        init = np.asarray(init, dtype=np.float32)
+        if init.shape[0] != num_rows:
+            raise ValueError(
+                f"warm init has {init.shape[0]} rows, expected {num_rows}"
+            )
+        table = np.zeros((num_rows + 1, rank), np.float32)
+        k = min(rank, init.shape[1])
+        table[:num_rows, :k] = init[:, :k]
+        return jnp.asarray(table)
+
+    uf = _seed_table(key_u, init_user, num_users)
+    vf = _seed_table(key_i, init_item, num_items)
     uf = jnp.pad(uf * jnp.asarray(u_mask), ((0, n_u - num_users - 1), (0, 0)))
     vf = jnp.pad(vf * jnp.asarray(i_mask), ((0, n_i - num_items - 1), (0, 0)))
     if mesh is not None:

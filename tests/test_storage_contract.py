@@ -19,7 +19,14 @@ from predictionio_tpu.data.storage.base import (
     Model,
     StorageClientConfig,
 )
-from predictionio_tpu.data.storage import localfs, memory, remote, sharedfs, sqlite
+from predictionio_tpu.data.storage import (
+    columnar,
+    localfs,
+    memory,
+    remote,
+    sharedfs,
+    sqlite,
+)
 
 UTC = dt.timezone.utc
 APP = 7
@@ -29,6 +36,14 @@ def _client(kind: str, tmp_path):
     """Returns (client, closer)."""
     if kind == "memory":
         c = memory.StorageClient(StorageClientConfig("T", "memory"))
+        return c, c.close
+    if kind == "columnar":
+        c = columnar.StorageClient(
+            StorageClientConfig(
+                "C", "columnar",
+                {"path": str(tmp_path / "cols"), "segment_rows": "4"},
+            )
+        )
         return c, c.close
     if kind == "sqlite":
         c = sqlite.StorageClient(
@@ -71,6 +86,16 @@ def client(request, tmp_path):
     closer()
 
 
+#: events-only spec additionally runs against the columnar driver (it has
+#: no metadata role — like the reference's HBase source, it is an
+#: EVENTDATA backend; segment_rows=4 forces multi-segment coverage)
+@pytest.fixture(params=["memory", "sqlite", "remote", "columnar"])
+def events_client(request, tmp_path):
+    c, closer = _client(request.param, tmp_path)
+    yield c
+    closer()
+
+
 def _ev(name="rate", entity="u1", target=None, t=0, props=None):
     return Event(
         event=name, entity_type="user", entity_id=entity,
@@ -82,8 +107,8 @@ def _ev(name="rate", entity="u1", target=None, t=0, props=None):
 
 
 class TestLEventsContract:
-    def test_insert_get_delete(self, client):
-        le = client.get_l_events()
+    def test_insert_get_delete(self, events_client):
+        le = events_client.get_l_events()
         le.init(APP)
         eid = le.insert(_ev(props={"rating": 5.0}, target="i1"), APP)
         got = le.get(eid, APP)
@@ -95,8 +120,8 @@ class TestLEventsContract:
         assert le.get(eid, APP) is None
         assert not le.delete(eid, APP)
 
-    def test_find_filters(self, client):
-        le = client.get_l_events()
+    def test_find_filters(self, events_client):
+        le = events_client.get_l_events()
         le.init(APP)
         le.insert(_ev("view", "u1", target="i1", t=0), APP)
         le.insert(_ev("rate", "u1", target="i2", t=10), APP)
@@ -116,8 +141,8 @@ class TestLEventsContract:
         newest = list(le.find(APP, limit=1, reversed=True))
         assert newest[0].entity_id == "u2"
 
-    def test_channel_isolation(self, client):
-        le = client.get_l_events()
+    def test_channel_isolation(self, events_client):
+        le = events_client.get_l_events()
         le.init(APP)
         le.init(APP, 3)
         le.insert(_ev("view", "u1"), APP)
@@ -128,8 +153,8 @@ class TestLEventsContract:
         le.init(APP, 3)
         assert list(le.find(APP, 3)) == []
 
-    def test_insert_batch(self, client):
-        le = client.get_l_events()
+    def test_insert_batch(self, events_client):
+        le = events_client.get_l_events()
         le.init(APP)
         ids = le.insert_batch([_ev(t=i) for i in range(5)], APP)
         assert len(ids) == len(set(ids)) == 5
@@ -137,8 +162,8 @@ class TestLEventsContract:
 
 
 class TestPEventsContract:
-    def test_write_find_shards(self, client):
-        pe = client.get_p_events()
+    def test_write_find_shards(self, events_client):
+        pe = events_client.get_p_events()
         pe.write([_ev("rate", f"u{i}", target=f"i{i}", t=i) for i in range(10)], APP)
         allev = list(pe.find(APP))
         assert len(allev) == 10
@@ -147,8 +172,8 @@ class TestPEventsContract:
         assert ids == sorted(f"u{i}" for i in range(10))
         assert all(len(s) > 0 for s in shards)
 
-    def test_delete_all(self, client):
-        pe = client.get_p_events()
+    def test_delete_all(self, events_client):
+        pe = events_client.get_p_events()
         pe.write([_ev(t=i) for i in range(3)], APP)
         pe.delete(APP)
         assert list(pe.find(APP)) == []
@@ -258,8 +283,8 @@ class TestFsModels:
 
 
 class TestReviewRegressions:
-    def test_empty_event_names_matches_nothing(self, client):
-        le = client.get_l_events()
+    def test_empty_event_names_matches_nothing(self, events_client):
+        le = events_client.get_l_events()
         le.init(APP)
         le.insert(_ev("view"), APP)
         assert list(le.find(APP, event_names=[])) == []
@@ -272,8 +297,8 @@ class TestReviewRegressions:
         a3 = apps.insert(App(0, "r3"))
         assert a3 is not None and a3 not in (a1, a1 + 1)
 
-    def test_limit_zero_and_negative(self, client):
-        le = client.get_l_events()
+    def test_limit_zero_and_negative(self, events_client):
+        le = events_client.get_l_events()
         le.init(APP)
         le.insert(_ev(), APP)
         assert list(le.find(APP, limit=0)) == []
@@ -285,8 +310,8 @@ class TestReviewRegressions:
         apps.insert(App(0, "n2"))
         assert apps.update(App(a1, "n2", None)) is False
 
-    def test_microsecond_roundtrip(self, client):
-        le = client.get_l_events()
+    def test_microsecond_roundtrip(self, events_client):
+        le = events_client.get_l_events()
         le.init(APP)
         t = dt.datetime(2021, 6, 1, 12, 0, 0, 123456, tzinfo=UTC)
         eid = le.insert(Event(event="v", entity_type="u", entity_id="1",
