@@ -204,16 +204,29 @@ class Engine:
         self._sanity(pd, sanity_check, "prepared data")
         if stop_after_prepare:
             return []
+        # pair warm models to algorithms by NAME (position as tie-break for
+        # duplicate names): a reordered algorithms list must still seed
+        # every algorithm whose predecessor exists
+        warm_pool = list(warm_models) if warm_models else []
+
+        def take_warm(i: int, name: str):
+            if i < len(warm_pool) and warm_pool[i] is not None and warm_pool[i][0] == name:
+                model = warm_pool[i][1]
+                warm_pool[i] = None
+                return model
+            for j, entry in enumerate(warm_pool):
+                if entry is not None and entry[0] == name:
+                    warm_pool[j] = None
+                    return entry[1]
+            return None
+
         models = []
         for i, (name, algo) in enumerate(algorithms):
             logger.info("Training algorithm '%s' (%s)", name, type(algo).__name__)
             a_ctx = ctx
-            if (
-                warm_models is not None
-                and i < len(warm_models)
-                and warm_models[i][0] == name
-            ):
-                a_ctx = _dc.replace(ctx, warm_model=warm_models[i][1])
+            warm = take_warm(i, name)
+            if warm is not None:
+                a_ctx = _dc.replace(ctx, warm_model=warm)
             key = f"train:{name}"
             if timings is not None and key in timings:
                 key = f"train:{name}#{i}"  # same algorithm listed twice

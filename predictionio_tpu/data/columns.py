@@ -48,38 +48,6 @@ class EventColumns:
     def __len__(self) -> int:
         return int(self.event_code.shape[0])
 
-    def compacted(self) -> "EventColumns":
-        """Re-index entity/target vocabularies to only the ids actually
-        referenced by the surviving rows (filters can orphan vocabulary
-        entries; a BiMap built from an uncompacted vocab would allocate
-        factor rows for entities that contributed no events)."""
-        used_e = np.unique(self.entity_code)
-        used_t = np.unique(self.target_code[self.target_code >= 0])
-        entity_code = np.searchsorted(used_e, self.entity_code).astype(np.int32)
-        target_code = np.full_like(self.target_code, -1)
-        has_t = self.target_code >= 0
-        target_code[has_t] = np.searchsorted(
-            used_t, self.target_code[has_t]
-        ).astype(np.int32)
-        return dataclasses.replace(
-            self,
-            entity_code=entity_code,
-            entity_vocab=self.entity_vocab[used_e],
-            target_code=target_code,
-            target_vocab=self.target_vocab[used_t],
-        )
-
-    def select(self, mask_or_index: np.ndarray) -> "EventColumns":
-        """Row subset (same vocabularies)."""
-        return dataclasses.replace(
-            self,
-            event_code=self.event_code[mask_or_index],
-            entity_code=self.entity_code[mask_or_index],
-            target_code=self.target_code[mask_or_index],
-            event_time_us=self.event_time_us[mask_or_index],
-            prop=None if self.prop is None else self.prop[mask_or_index],
-        )
-
 
 def encode_strings(values: list) -> tuple[np.ndarray, np.ndarray]:
     """strings -> (codes int32, sorted vocab). None is not allowed here."""

@@ -218,12 +218,22 @@ class _ColumnarEvents(LEvents):
     """LEvents over the segment + tail + tombstone layout (plus the shared
     machinery :class:`_ColumnarPEvents` delegates to)."""
 
-    def __init__(self, base: str, segment_rows: int, fsync: bool):
+    #: decoded segments kept hot (LRU): bounds resident memory at
+    #: ~cache_size·segment_rows rows instead of pinning the whole store
+    _CACHE_SEGMENTS = 8
+
+    def __init__(self, base: str, segment_rows: int, fsync: bool,
+                 cache_segments: int | None = None):
         self._base = base
         self._segment_rows = segment_rows
         self._fsync = fsync
         self._lock = threading.RLock()
-        self._seg_cache: dict[str, _Segment] = {}
+        from collections import OrderedDict
+
+        self._seg_cache: "OrderedDict[str, _Segment]" = OrderedDict()
+        self._cache_segments = (
+            self._CACHE_SEGMENTS if cache_segments is None else cache_segments
+        )
         self._seg_seq = 0
 
     # ---------------------------------------------------------- paths
@@ -251,6 +261,10 @@ class _ColumnarEvents(LEvents):
             if seg is None:
                 seg = _load_segment(path)
                 self._seg_cache[path] = seg
+                while len(self._seg_cache) > max(self._cache_segments, 0):
+                    self._seg_cache.popitem(last=False)
+            else:
+                self._seg_cache.move_to_end(path)
             return seg
 
     def _tombstones(self, d: str) -> set[str]:
@@ -321,9 +335,8 @@ class _ColumnarEvents(LEvents):
             return False
         with self._lock:
             shutil.rmtree(d)
-            self._seg_cache = {
-                p: s for p, s in self._seg_cache.items() if not p.startswith(d)
-            }
+            for p in [p for p in self._seg_cache if p.startswith(d)]:
+                del self._seg_cache[p]
         return True
 
     def insert(self, event: Event, app_id: int, channel_id: int | None = None) -> str:
@@ -869,9 +882,15 @@ class StorageClient(BaseStorageClient):
             config.properties.get("segment_rows", _DEFAULT_SEGMENT_ROWS)
         )
         fsync = config.properties.get("fsync", "false").lower() == "true"
+        cache_segments = config.properties.get("cache_segments")
         base = os.path.join(os.path.expanduser(path), f"{prefix}_events")
         os.makedirs(base, exist_ok=True)
-        self._events = _ColumnarEvents(base, segment_rows, fsync)
+        self._events = _ColumnarEvents(
+            base, segment_rows, fsync,
+            cache_segments=(
+                int(cache_segments) if cache_segments is not None else None
+            ),
+        )
         self._pevents = _ColumnarPEvents(self._events)
 
     def get_l_events(self) -> LEvents:

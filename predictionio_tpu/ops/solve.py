@@ -152,14 +152,17 @@ def gj_solve_pallas(
     A: jax.Array,  # [B, K, K]
     b: jax.Array,  # [B, K]
     block_rows: int | None = None,
-    pivot_block: int = _PIVOT_BLOCK,
+    pivot_block: int | None = None,
     interpret: bool = False,
 ) -> jax.Array:
     """Batched SPD solve, blocked Gauss-Jordan in VMEM. B is padded to a
     multiple of ``block_rows`` (default: auto-sized to the VMEM budget
     for this K); padding rows are identity systems (solve to 0); K must
-    be a multiple of ``pivot_block``."""
+    be a multiple of ``pivot_block`` (default ``_PIVOT_BLOCK``, read at
+    call time so measurements can tune the module knobs)."""
     B, K = b.shape
+    if pivot_block is None:
+        pivot_block = _PIVOT_BLOCK
     if K % pivot_block:
         raise ValueError(f"K={K} must be a multiple of pivot_block={pivot_block}")
     if block_rows is None:

@@ -182,9 +182,8 @@ def pairwise_exchange(payloads: Sequence[bytes], timeout: float = 300.0) -> list
 
     results: list = [None] * P
     results[me] = payloads[me]
-    filled = threading.Event()  # all P-1 peer payloads received
     fatal: list = []  # post-authentication failures (peers never retry)
-    done = threading.Event()  # filled OR fatal — wakes the main thread
+    done = threading.Event()  # all peers reported OR fatal
 
     def handle(conn: socket.socket, peer: Any) -> None:
         authenticated = False
@@ -211,7 +210,6 @@ def pairwise_exchange(payloads: Sequence[bytes], timeout: float = 300.0) -> list
                 results[rank] = _recv_exact(conn, length)
                 _count("p2p_received", length)
                 if all(r is not None for r in results):
-                    filled.set()
                     done.set()
         except Exception as e:
             if authenticated:

@@ -464,32 +464,18 @@ class ALSAlgorithm(JaxAlgorithm):
         if self.params.serve_on_device:
             import jax
 
+            from predictionio_tpu.templates.serving_util import device_latency_ok
+
             model.user_factors = jax.device_put(np.asarray(model.user_factors))
             model.item_factors = jax.device_put(np.asarray(model.item_factors))
             if len(model.user_index):
                 probe = Query(user=model.user_index.keys()[0], num=4)
-                self.predict(model, probe)  # compile warm-up
-                budget = self.params.device_latency_budget_ms
-                if budget > 0:
-                    import time
-
-                    lat = []
-                    for _ in range(5):
-                        t0 = time.perf_counter()
-                        self.predict(model, probe)
-                        lat.append((time.perf_counter() - t0) * 1e3)
-                    p50 = sorted(lat)[len(lat) // 2]
-                    if p50 > budget:
-                        logging.getLogger(__name__).warning(
-                            "serveOnDevice probe: median device query "
-                            "latency %.1f ms exceeds the %.1f ms budget "
-                            "(remote/tunneled accelerator?) — falling "
-                            "back to host serving. Set "
-                            "deviceLatencyBudgetMs <= 0 to force device.",
-                            p50, budget,
-                        )
-                        model.user_factors = np.asarray(model.user_factors)
-                        model.item_factors = np.asarray(model.item_factors)
+                if not device_latency_ok(
+                    lambda: self.predict(model, probe),
+                    self.params.device_latency_budget_ms,
+                ):
+                    model.user_factors = np.asarray(model.user_factors)
+                    model.item_factors = np.asarray(model.item_factors)
             return model
         model.user_factors = np.ascontiguousarray(model.user_factors)
         model.item_factors = np.ascontiguousarray(model.item_factors)

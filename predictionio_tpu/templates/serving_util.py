@@ -1,0 +1,44 @@
+"""Shared serving-path helpers for engine templates."""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Callable
+
+__all__ = ["device_latency_ok"]
+
+logger = logging.getLogger(__name__)
+
+
+def device_latency_ok(
+    predict_once: Callable[[], None],
+    budget_ms: float,
+    samples: int = 5,
+) -> bool:
+    """Deploy-time guardrail for ``serveOnDevice``: measure the real
+    per-query device latency and report whether its median fits the
+    budget. A remote/tunneled accelerator pays an RTT per dispatch that
+    silently blows the reference's <10 ms serving target otherwise.
+    ``budget_ms <= 0`` disables the probe (always trust the caller).
+    The first call is a warm-up (compile) and is not measured."""
+    predict_once()
+    if budget_ms <= 0:
+        return True
+    lat = []
+    for _ in range(samples):
+        t0 = time.perf_counter()
+        predict_once()
+        lat.append((time.perf_counter() - t0) * 1e3)
+    p50 = sorted(lat)[len(lat) // 2]
+    if p50 > budget_ms:
+        logger.warning(
+            "serveOnDevice probe: median device query latency %.1f ms "
+            "exceeds the %.1f ms budget (remote/tunneled accelerator?) — "
+            "falling back to host serving. Set the budget <= 0 to force "
+            "device.",
+            p50,
+            budget_ms,
+        )
+        return False
+    return True

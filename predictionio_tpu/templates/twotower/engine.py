@@ -203,10 +203,17 @@ class TwoTowerParams(Params):
     learning_rate: float = 0.05
     temperature: float = 0.1
     seed: int = 0
+    #: rank the catalog on the accelerator (huge catalogs / batched
+    #: queries); guarded by the same deploy-time latency probe as the
+    #: ALS template
+    serve_on_device: bool = False
+    device_latency_budget_ms: float = 10.0
     json_aliases = {
         "embeddingDim": "embedding_dim",
         "batchSize": "batch_size",
         "learningRate": "learning_rate",
+        "serveOnDevice": "serve_on_device",
+        "deviceLatencyBudgetMs": "device_latency_budget_ms",
     }
 
 
@@ -256,6 +263,22 @@ class TwoTowerAlgorithm(JaxAlgorithm):
     def prepare_model_for_serving(
         self, model: TwoTowerServingModel
     ) -> TwoTowerServingModel:
+        if self.params.serve_on_device:
+            import jax
+
+            from predictionio_tpu.templates.serving_util import device_latency_ok
+
+            model.user_vecs = jax.device_put(np.asarray(model.user_vecs))
+            model.item_vecs = jax.device_put(np.asarray(model.item_vecs))
+            if len(model.user_index):
+                probe = Query(user=model.user_index.keys()[0], num=4)
+                if not device_latency_ok(
+                    lambda: self.predict(model, probe),
+                    self.params.device_latency_budget_ms,
+                ):
+                    model.user_vecs = np.asarray(model.user_vecs)
+                    model.item_vecs = np.asarray(model.item_vecs)
+            return model
         model.user_vecs = np.ascontiguousarray(model.user_vecs)
         model.item_vecs = np.ascontiguousarray(model.item_vecs)
         if len(model.user_index):
@@ -270,15 +293,25 @@ class TwoTowerAlgorithm(JaxAlgorithm):
         k = min(int(query.num) + len(seen), len(model.item_index))
         if k <= 0:
             return PredictedResult(())
-        scores = model.item_vecs @ np.asarray(model.user_vecs[uidx])
-        part = np.argpartition(scores, -k)[-k:]
-        top = part[np.argsort(scores[part])[::-1]]
+        if isinstance(model.item_vecs, np.ndarray):
+            scores = model.item_vecs @ np.asarray(model.user_vecs[uidx])
+            part = np.argpartition(scores, -k)[-k:]
+            top = part[np.argsort(scores[part])[::-1]]
+            pairs = [(int(i), float(scores[i])) for i in top]
+        else:
+            from predictionio_tpu.ops.als import top_k_items
+
+            idx, sc = top_k_items(model.user_vecs[uidx], model.item_vecs, k)
+            pairs = [
+                (int(i), float(s))
+                for i, s in zip(np.asarray(idx), np.asarray(sc))
+            ]
         out = []
-        for i in top:
-            item = model.item_index.inverse(int(i))
+        for i, score in pairs:
+            item = model.item_index.inverse(i)
             if item in seen:
                 continue
-            out.append(ItemScore(item=item, score=float(scores[i])))
+            out.append(ItemScore(item=item, score=score))
             if len(out) >= int(query.num):
                 break
         return PredictedResult(tuple(out))

@@ -132,17 +132,45 @@ def run_train(
                 else None
             )
             if blob is not None:
-                warm_models = engine.models_from_bytes(
-                    engine_params, prev.id, blob.models
-                )
-                warm_from = prev.id
-                logger.info(
-                    "Warm-starting from completed instance %s", prev.id
-                )
+                try:
+                    warm_models = engine.models_from_bytes(
+                        engine_params, prev.id, blob.models
+                    )
+                    warm_from = prev.id
+                except ValueError as e:
+                    # e.g. the algorithm list changed since the predecessor
+                    # — a routine config change must not turn the retrain
+                    # flag into a hard failure
+                    logger.warning(
+                        "--warm-start: predecessor model %s is incompatible "
+                        "with the current engine params (%s); cold start",
+                        prev.id, e,
+                    )
             else:
                 logger.warning(
                     "--warm-start requested but no completed instance with a "
                     "stored model exists for this engine/variant; cold start"
+                )
+            if ctx.num_hosts > 1:
+                # ALL hosts must agree to warm-start (and from the same
+                # blob): a host whose models repo lacks the blob would
+                # otherwise cold-init while others warm-init, silently
+                # breaking the identical-init invariant of the sharded
+                # train
+                from predictionio_tpu.parallel.exchange import allgather_objects
+
+                have = allgather_objects(warm_from)
+                if any(h != have[0] for h in have):
+                    logger.warning(
+                        "--warm-start: not every host could load the "
+                        "predecessor model (%s); cold start everywhere",
+                        have,
+                    )
+                    warm_models = None
+                    warm_from = None
+            if warm_from is not None:
+                logger.info(
+                    "Warm-starting from completed instance %s", warm_from
                 )
         timings: dict = {}
         models = engine.train(

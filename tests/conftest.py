@@ -13,16 +13,21 @@ import os
 # TPU tunnel, so env vars alone are too late — update the jax config before
 # any backend is initialized (backends are created lazily at first
 # jax.devices()/dispatch).
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+#
+# PIO_TEST_TPU=1 keeps the real accelerator backend instead — the escape
+# hatch for the hardware-marked suites (tests/test_pallas_tpu.py), which
+# CI skips and the bench environment runs.
+if os.environ.get("PIO_TEST_TPU") != "1":
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
 
-import jax  # noqa: E402
+    import jax
 
-jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

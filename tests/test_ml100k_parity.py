@@ -1,0 +1,162 @@
+"""MovieLens-100K quickstart parity (BASELINE.md configs[0]).
+
+Runs the documented quickstart END TO END — events into the columnar
+store, `run_train` through the real Recommendation template (rank=10,
+10 iterations, lambda=0.1: the classic MLlib ALS example settings), the
+model re-hydrated from the Models repo — and pins the held-out RMSE:
+
+* inside the measured band (deterministic dataset + seeds);
+* far below the mean-only predictor;
+* within a few percent of an INDEPENDENT CPU implementation of the same
+  algorithm (the tuned-numpy ALS that benchmarks the baseline) — the
+  actual "MLlib-equivalent results" claim, since both implement MLlib's
+  ALS-WR normal equations.
+
+Set ``ML100K_PATH=/path/to/u.data`` to run against the real file (this
+sandbox has no network, so CI uses the deterministic structural replica
+— exact shape, exact rating histogram, learnable planted structure).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.utils.movielens import (
+    ML100K_HISTOGRAM,
+    ml100k_dataset,
+    synthesize_ml100k,
+)
+
+RANK, ITERS, LAMBDA = 10, 10, 0.1
+
+
+@pytest.fixture(scope="module")
+def split():
+    u, i, r, t, source = ml100k_dataset()
+    rng = np.random.default_rng(0)
+    perm = rng.permutation(len(r))
+    n_te = len(r) // 10
+    return u, i, r, perm[n_te:], perm[:n_te], source
+
+
+def _rmse(uf, vf, u, i, r, idx):
+    pred = np.einsum("nk,nk->n", uf[u[idx]], vf[i[idx]])
+    return float(np.sqrt(np.mean((pred - r[idx]) ** 2)))
+
+
+class TestReplicaShape:
+    def test_exact_ml100k_marginals(self):
+        u, i, r, t = synthesize_ml100k()
+        assert len(r) == 100_000
+        assert u.max() + 1 == 943 and i.max() + 1 == 1682
+        assert tuple(np.bincount(r.astype(int))[1:]) == ML100K_HISTOGRAM
+        assert np.bincount(u).min() >= 20  # the real dataset's floor
+        # deterministic: a second draw is identical
+        u2, i2, r2, t2 = synthesize_ml100k()
+        assert (u == u2).all() and (r == r2).all()
+
+
+class TestQuickstartParity:
+    def test_pipeline_rmse_band_and_reference_parity(self, split, tmp_path):
+        from predictionio_tpu.controller import local_context
+        from predictionio_tpu.data.storage import Storage
+        from predictionio_tpu.data.storage.base import App
+        from predictionio_tpu.workflow import load_engine_variant, run_train
+
+        u, i, r, tr, te, source = split
+        Storage.configure(
+            {
+                "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+                "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+                "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+                "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "COL",
+                "PIO_STORAGE_SOURCES_COL_TYPE": "columnar",
+                "PIO_STORAGE_SOURCES_COL_PATH": str(tmp_path / "ml"),
+            }
+        )
+        try:
+            app_id = Storage.get_meta_data_apps().insert(App(id=0, name="ml100k"))
+            Storage.get_p_events().write_columns(
+                app_id,
+                event="rate",
+                entity_type="user",
+                entity_codes=u[tr],
+                entity_vocab=np.asarray([str(x) for x in range(943)]),
+                target_entity_type="item",
+                target_codes=i[tr],
+                target_vocab=np.asarray([str(x) for x in range(1682)]),
+                event_time_us=np.full(tr.size, 1_600_000_000_000_000, np.int64),
+                props={"rating": r[tr].astype(np.float64)},
+            )
+            variant = load_engine_variant(
+                {
+                    "id": "ml100k-quickstart",
+                    "version": "1",
+                    "engineFactory": "predictionio_tpu.templates.recommendation:engine_factory",
+                    "datasource": {"params": {"appName": "ml100k"}},
+                    "algorithms": [
+                        {
+                            "name": "als",
+                            "params": {
+                                "rank": RANK,
+                                "numIterations": ITERS,
+                                "lambda": LAMBDA,
+                                "seed": 1,
+                            },
+                        }
+                    ],
+                }
+            )
+            instance = run_train(variant, local_context())
+            assert instance.status == "COMPLETED"
+            # re-hydrate the model exactly as deploy would
+            engine = variant.build_engine()
+            ep = variant.engine_params(engine)
+            blob = Storage.get_model_data_models().get(instance.id)
+            (_name, model), = engine.models_from_bytes(ep, instance.id, blob.models)
+            uf = np.zeros((943, RANK), np.float32)
+            vf = np.zeros((1682, RANK), np.float32)
+            for key, row in model.user_index.to_dict().items():
+                uf[int(key)] = model.user_factors[row]
+            for key, row in model.item_index.to_dict().items():
+                vf[int(key)] = model.item_factors[row]
+        finally:
+            Storage.configure(None)
+
+        test_rmse = _rmse(uf, vf, u, i, r, te)
+        train_rmse = _rmse(uf, vf, u, i, r, tr)
+        mean_only = float(np.sqrt(np.mean((r[tr].mean() - r[te]) ** 2)))
+        print(
+            json.dumps(
+                {
+                    "source": source,
+                    "train_rmse": round(train_rmse, 4),
+                    "test_rmse": round(test_rmse, 4),
+                    "mean_only_test_rmse": round(mean_only, 4),
+                }
+            )
+        )
+        # measured band on the deterministic replica: 0.8288 +- backend
+        # noise. On the REAL file (ML100K_PATH) the published MLlib-ALS
+        # ballpark is ~0.91-0.95 — widen via the mean-only guard instead
+        # of a file-specific band.
+        if "replica" in source:
+            assert 0.78 <= test_rmse <= 0.88, test_rmse
+        assert test_rmse < mean_only - 0.2
+
+        # --- independent same-algorithm reference (tuned numpy ALS) -----
+        import bench as bench_mod
+
+        from predictionio_tpu.ops.als import build_buckets
+
+        ub = build_buckets(u[tr], i[tr], r[tr], 943, 1682)
+        ib = build_buckets(i[tr], u[tr], r[tr], 1682, 943)
+        rng = np.random.default_rng(1)
+        cu = np.abs(rng.normal(size=(944, RANK))).astype(np.float32) / np.sqrt(RANK)
+        cv = np.abs(rng.normal(size=(1683, RANK))).astype(np.float32) / np.sqrt(RANK)
+        for _ in range(ITERS):
+            cu, cv = bench_mod._cpu_als_sweep(ub, ib, cu, cv, RANK, reg=LAMBDA)
+        ref_rmse = _rmse(cu[:943], cv[:1682], u, i, r, te)
+        # same algorithm, independent implementation: agree within 3%
+        assert abs(test_rmse - ref_rmse) / ref_rmse < 0.03, (test_rmse, ref_rmse)
