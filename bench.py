@@ -338,6 +338,7 @@ def _bench_workflow(nnz: int, rank: int, iters: int) -> dict:
     tmp = tempfile.mkdtemp(prefix="pio-bench-events-")
     Storage.configure(
         {
+            "PIO_FS_BASEDIR": os.path.join(tmp, "base"),
             "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
             "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
             "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",

@@ -244,7 +244,25 @@ class _ColumnarEvents(LEvents):
     def _ensure_stream(self, app_id: int, channel_id: int | None) -> str:
         d = self._stream_dir(app_id, channel_id)
         os.makedirs(d, exist_ok=True)
+        sid = os.path.join(d, "stream_id")
+        # identity marker: lets incremental readers detect that a stream
+        # was dropped and recreated (their cache must not count the new
+        # tail as already-consumed). Written atomically, and an empty
+        # file (crash mid-write) is repaired rather than left disabling
+        # incremental reads forever.
+        if not os.path.exists(sid) or os.path.getsize(sid) == 0:
+            tmp = sid + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(uuid.uuid4().hex)
+            os.replace(tmp, sid)
         return d
+
+    def _stream_id(self, d: str) -> str:
+        try:
+            with open(os.path.join(d, "stream_id")) as f:
+                return f.read().strip()
+        except FileNotFoundError:
+            return ""
 
     def _segment_paths(self, d: str) -> list[str]:
         if not os.path.isdir(d):
@@ -326,7 +344,7 @@ class _ColumnarEvents(LEvents):
 
     # ---------------------------------------------------------- LEvents
     def init(self, app_id: int, channel_id: int | None = None) -> bool:
-        os.makedirs(self._stream_dir(app_id, channel_id), exist_ok=True)
+        self._ensure_stream(app_id, channel_id)
         return True
 
     def remove(self, app_id: int, channel_id: int | None = None) -> bool:
@@ -698,6 +716,30 @@ class _ColumnarEvents(LEvents):
                 os.fsync(f.fileno())
         os.replace(tmp, path)
 
+    def scan_state(self, app_id: int, channel_id: int | None = None) -> dict:
+        """Snapshot of the stream's physical inputs — the incremental
+        re-index manifest. Segments are immutable and the tail is
+        append-only, so a reader that recorded this state can later read
+        ONLY the segments/tail lines added since (``segments`` +
+        ``tail_skip`` on :meth:`find_columns`), provided the tombstone
+        count is unchanged and its recorded segments still exist."""
+        d = self._stream_dir(app_id, channel_id)
+        tail_lines = 0
+        try:
+            with open(os.path.join(d, "tail.jsonl")) as f:
+                tail_lines = sum(1 for line in f if line.strip())
+        except FileNotFoundError:
+            pass
+        return {
+            "stream_id": self._stream_id(d),
+            "segments": sorted(
+                os.path.splitext(os.path.basename(p))[0]
+                for p in self._segment_paths(d)
+            ),
+            "tail_lines": tail_lines,
+            "tombstones": len(self._tombstones(d)),
+        }
+
     def find_columns(
         self,
         app_id: int,
@@ -710,11 +752,15 @@ class _ColumnarEvents(LEvents):
         prop: str | None = None,
         shard_index: int = 0,
         num_shards: int = 1,
+        segments: Sequence[str] | None = None,
+        tail_skip: int = 0,
     ) -> EventColumns:
         """Array-speed columnar scan: per-segment vectorized filters, then
         one vocabulary merge — no per-event Python except for the (small)
         JSONL tail and rows whose requested property lives in the JSON
-        residue."""
+        residue. ``segments`` restricts the scan to the named segment
+        files and ``tail_skip`` skips the first N tail lines — the delta
+        read of an incremental re-index (see :meth:`scan_state`)."""
         d = self._stream_dir(app_id, channel_id)
         tail_tomb, tomb_rows = self._split_tombstones(self._tombstones(d))
 
@@ -724,7 +770,15 @@ class _ColumnarEvents(LEvents):
         times: list[np.ndarray] = []
         props: list[np.ndarray] = []
 
-        for path in self._segment_paths(d):
+        seg_paths = self._segment_paths(d)
+        if segments is not None:
+            wanted = set(segments)
+            seg_paths = [
+                p
+                for p in seg_paths
+                if os.path.splitext(os.path.basename(p))[0] in wanted
+            ]
+        for path in seg_paths:
             seg = self._segment(path)
             mask = self._matching_mask(
                 seg, start_time, until_time, entity_type, None,
@@ -767,8 +821,9 @@ class _ColumnarEvents(LEvents):
 
         tail = [
             e
-            for e in self._tail_events(d)
-            if e.event_id not in tail_tomb
+            for j, e in enumerate(self._tail_events(d))
+            if j >= tail_skip
+            and e.event_id not in tail_tomb
             and BaseStorageClient.match_filters(
                 e, start_time, until_time, entity_type, None,
                 event_names, target_entity_type, None,
@@ -859,6 +914,9 @@ class _ColumnarPEvents(PEvents):
 
     def find_columns(self, app_id: int, channel_id: int | None = None, **kw):
         return self._e.find_columns(app_id, channel_id, **kw)
+
+    def scan_state(self, app_id: int, channel_id: int | None = None) -> dict:
+        return self._e.scan_state(app_id, channel_id)
 
 
 class StorageClient(BaseStorageClient):

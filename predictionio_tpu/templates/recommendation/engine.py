@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import os
 from typing import Any, Sequence
 
 import numpy as np
@@ -97,6 +98,11 @@ class DataSourceParams(Params):
     buy_rating: float = 4.0
     #: eval folds for read_eval
     eval_k: int = 3
+    #: on an append-only columnar event store, repeat trains read only
+    #: the segments/tail added since the cached previous read (the
+    #: incremental re-index of SURVEY §8.3); safe fallback to a full
+    #: read whenever the cache is stale or the store is not columnar
+    incremental: bool = True
     json_aliases = {"appName": "app_name", "evalK": "eval_k"}
 
 
@@ -182,26 +188,13 @@ class RecommendationDataSource(DataSource):
         vals = np.fromiter((r for _, _, r in triples), np.float32, len(triples))
         return TrainingData(rows, cols, vals, user_index, item_index)
 
-    def _read_training_columnar(self, ctx: WorkflowContext) -> TrainingData:
-        """Vectorized single-host read: the columnar bulk scan
-        (``PEventStore.find_columns``) plus numpy dedup/BiMap — no
-        per-event Python, which is what lets the FULL product path
-        (event store → template → ALS) keep up with the TPU at 10^7+
-        events (VERDICT r3 next-round #1). Semantics are identical to
-        :meth:`_read_ratings`: latest event per (user, item) wins, ties
-        break toward the higher rating, rate events must carry a numeric
-        ``rating`` property."""
+    def _extract_ratings_arrays(self, cols):
+        """EventColumns -> (u_code, i_code, event_time_us, rating) in the
+        columns' own vocab space; validates that rate events carry a
+        numeric rating (same error semantics as the event-stream path)."""
         from predictionio_tpu.data.event import EventValidationError
 
         p = self.params
-        cols = PEventStore.find_columns(
-            app_name=p.app_name,
-            entity_type="user",
-            event_names=[p.rate_event, p.buy_event],
-            prop="rating",
-            shard_index=ctx.host_index,
-            num_shards=ctx.num_hosts,
-        )
         is_buy = np.zeros(len(cols), dtype=bool)
         bi = np.searchsorted(cols.event_vocab, p.buy_event)
         if bi < cols.event_vocab.size and cols.event_vocab[bi] == p.buy_event:
@@ -220,23 +213,33 @@ class RecommendationDataSource(DataSource):
                 f"property (first offender: entity {u!r})"
             )
         if keep.all():
-            u_code, i_code = cols.entity_code, cols.target_code
-            t_arr = cols.event_time_us
-            v = vals.astype(np.float32, copy=False)
-        else:
-            u_code, i_code = cols.entity_code[keep], cols.target_code[keep]
-            t_arr = cols.event_time_us[keep]
-            v = vals[keep].astype(np.float32, copy=False)
-        # latest-wins dedup, each pair's max((event_time, rating)) — the
-        # same order-independent rule as the event-stream path. One
-        # argsort groups the pairs; only rows inside duplicate groups
-        # (usually a tiny fraction) pay the 3-key lexsort.
-        # pair key in the narrowest dtype that fits: halves the sort's
-        # memory traffic on the (single-core) host for typical catalogs
-        span = (int(cols.entity_vocab.size)) * (int(cols.target_vocab.size) + 1)
+            return (
+                cols.entity_code,
+                cols.target_code,
+                cols.event_time_us,
+                vals.astype(np.float32, copy=False),
+            )
+        return (
+            cols.entity_code[keep],
+            cols.target_code[keep],
+            cols.event_time_us[keep],
+            vals[keep].astype(np.float32, copy=False),
+        )
+
+    @staticmethod
+    def _assemble_training_data(
+        u_code, i_code, t_arr, v, user_vocab, item_vocab
+    ):
+        """Dedup (latest wins, ties -> higher rating) + vocabulary
+        compaction; returns (TrainingData, cache_payload). One argsort
+        groups the pairs; only rows inside duplicate groups (usually a
+        tiny fraction) pay the 3-key lexsort. The pair key uses the
+        narrowest dtype that fits — halves the sort's memory traffic on
+        the (single-core) host for typical catalogs."""
+        span = int(user_vocab.size) * (int(item_vocab.size) + 1)
         pair_dt = np.uint32 if span < 2**32 else np.int64
         pair = u_code.astype(pair_dt) * pair_dt(
-            cols.target_vocab.size + 1
+            item_vocab.size + 1
         ) + i_code.astype(pair_dt)
         # stability is irrelevant: duplicate groups are re-ranked below by
         # (time, rating), so the faster introsort wins over kind="stable"
@@ -249,8 +252,6 @@ class RecommendationDataSource(DataSource):
         sel = order[last]
         dup_groups = np.flatnonzero(sizes > 1)
         if dup_groups.size:
-            # re-rank rows inside duplicate groups only (re-keyed by a
-            # compact group index); all selection is vectorized
             rows_d = order[np.repeat(sizes > 1, sizes)]
             dsizes = sizes[dup_groups]
             group_of = np.repeat(np.arange(dup_groups.size), dsizes)
@@ -258,32 +259,240 @@ class RecommendationDataSource(DataSource):
             sel[dup_groups] = rows_d[o2[np.cumsum(dsizes) - 1]]
         u_sel = u_code[sel]
         i_sel = i_code[sel]
-        v = v[sel]
+        v_sel = v[sel]
+        t_sel = t_arr[sel]
         # compact the vocabularies to ids that survived (bincount is O(N),
         # unlike a sort-based unique)
-        u_hist = np.bincount(u_sel, minlength=cols.entity_vocab.size)
-        i_hist = np.bincount(i_sel, minlength=cols.target_vocab.size)
+        u_hist = np.bincount(u_sel, minlength=user_vocab.size)
+        i_hist = np.bincount(i_sel, minlength=item_vocab.size)
         used_u = np.flatnonzero(u_hist)
         used_i = np.flatnonzero(i_hist)
-        u_lut = np.zeros(cols.entity_vocab.size, np.int64)
+        u_lut = np.zeros(user_vocab.size, np.int64)
         u_lut[used_u] = np.arange(used_u.size)
-        i_lut = np.zeros(cols.target_vocab.size, np.int64)
+        i_lut = np.zeros(item_vocab.size, np.int64)
         i_lut[used_i] = np.arange(used_i.size)
         rows = u_lut[u_sel]
         cols_idx = i_lut[i_sel]
-        user_vocab = cols.entity_vocab[used_u].tolist()
-        item_vocab = cols.target_vocab[used_i].tolist()
-        return TrainingData(
+        uv_arr = user_vocab[used_u]
+        iv_arr = item_vocab[used_i]
+        user_list = uv_arr.tolist()
+        item_list = iv_arr.tolist()
+        td = TrainingData(
             rows=rows,
             cols=cols_idx,
-            vals=v,
+            vals=v_sel,
             user_index=BiMap.from_dict(
-                dict(zip(user_vocab, range(len(user_vocab))))
+                dict(zip(user_list, range(len(user_list))))
             ),
             item_index=BiMap.from_dict(
-                dict(zip(item_vocab, range(len(item_vocab))))
+                dict(zip(item_list, range(len(item_list))))
             ),
         )
+        cache_payload = {
+            "u_code": rows.astype(np.int32),
+            "i_code": cols_idx.astype(np.int32),
+            "t_us": t_sel.astype(np.int64),
+            "vals": v_sel,
+            "user_vocab": uv_arr,
+            "item_vocab": iv_arr,
+        }
+        return td, cache_payload
+
+    # ---------------------------------------------------- incremental cache
+    def _cache_paths(self) -> tuple[str, str]:
+        import re
+
+        from predictionio_tpu.data.storage import Storage
+
+        safe = re.sub(r"[^A-Za-z0-9_-]", "_", self.params.app_name)
+        base = os.path.join(Storage.base_dir(), "train_cache")
+        return (
+            os.path.join(base, f"{safe}.npz"),
+            os.path.join(base, f"{safe}.json"),
+        )
+
+    def _cache_manifest(self) -> dict:
+        p = self.params
+        return {
+            "version": 1,
+            "app": p.app_name,
+            "rate_event": p.rate_event,
+            "buy_event": p.buy_event,
+            "buy_rating": p.buy_rating,
+        }
+
+    def _try_incremental(self, pe, app_id) -> TrainingData | None:
+        """Delta re-index on an append-only columnar store (SURVEY §8.3
+        "incremental re-index on new events"): if a previous train's
+        cache is still a valid prefix of the store (its segments all
+        exist, no new tombstones, tail only appended), read ONLY the
+        segments/tail lines added since, merge with the cached deduped
+        matrix, and re-dedup. The reference gets the same effect from
+        Spark RDD caching; here the cache is an explicit on-disk
+        artifact that survives processes."""
+        import json
+
+        npz_path, json_path = self._cache_paths()
+        try:
+            with open(json_path) as f:
+                meta = json.load(f)
+        except (FileNotFoundError, ValueError):
+            return None
+        if meta.get("manifest") != self._cache_manifest():
+            return None
+        state = pe.scan_state(app_id)
+        cached_segments = set(meta.get("segments", ()))
+        if (
+            meta.get("stream_id") != state.get("stream_id")
+            or not meta.get("stream_id")
+            or meta.get("tombstones") != state["tombstones"]
+            or not cached_segments.issubset(set(state["segments"]))
+            or meta.get("tail_lines", 0) > state["tail_lines"]
+        ):
+            return None
+        new_segments = [
+            s for s in state["segments"] if s not in cached_segments
+        ]
+        with np.load(npz_path, allow_pickle=False) as z:
+            cache = {k: z[k] for k in z.files}
+        p = self.params
+        delta = pe.find_columns(
+            app_id,
+            entity_type="user",
+            event_names=[p.rate_event, p.buy_event],
+            prop="rating",
+            segments=new_segments,
+            tail_skip=int(meta.get("tail_lines", 0)),
+        )
+        du, di, dt_us, dv = self._extract_ratings_arrays(delta)
+        if du.size == 0:
+            # nothing new: the cache IS the training data — skip the
+            # merge/dedup entirely (the common retrain-without-new-events
+            # case, e.g. a hyperparameter retrain). Still advance the
+            # manifest when rating-free segments/tail appeared, so they
+            # are not re-scanned next time.
+            if (
+                meta.get("segments") != state["segments"]
+                or meta.get("tail_lines") != state["tail_lines"]
+            ):
+                self._save_cache(dict(cache), state)
+            user_list = cache["user_vocab"].tolist()
+            item_list = cache["item_vocab"].tolist()
+            logging.getLogger(__name__).info(
+                "Incremental re-index: store unchanged, reusing %d cached "
+                "ratings", cache["vals"].size,
+            )
+            return TrainingData(
+                rows=cache["u_code"].astype(np.int64),
+                cols=cache["i_code"].astype(np.int64),
+                vals=cache["vals"],
+                user_index=BiMap.from_dict(
+                    dict(zip(user_list, range(len(user_list))))
+                ),
+                item_index=BiMap.from_dict(
+                    dict(zip(item_list, range(len(item_list))))
+                ),
+            )
+        # unify vocabularies (cache vocab is exactly its used ids; du is
+        # non-empty past the early return above, so the delta vocabs are
+        # non-empty too)
+        user_vocab = np.unique(
+            np.concatenate([cache["user_vocab"], delta.entity_vocab])
+        )
+        item_vocab = np.unique(
+            np.concatenate([cache["item_vocab"], delta.target_vocab])
+        )
+        cu = np.searchsorted(user_vocab, cache["user_vocab"]).astype(np.int64)[
+            cache["u_code"]
+        ]
+        ci = np.searchsorted(item_vocab, cache["item_vocab"]).astype(np.int64)[
+            cache["i_code"]
+        ]
+        du = np.searchsorted(user_vocab, delta.entity_vocab).astype(np.int64)[du]
+        di = np.searchsorted(item_vocab, delta.target_vocab).astype(np.int64)[di]
+        td, payload = self._assemble_training_data(
+            np.concatenate([cu, du]),
+            np.concatenate([ci, di]),
+            np.concatenate([cache["t_us"], dt_us]),
+            np.concatenate([cache["vals"], dv]).astype(np.float32),
+            user_vocab,
+            item_vocab,
+        )
+        self._save_cache(payload, state)
+        logging.getLogger(__name__).info(
+            "Incremental re-index: merged %d cached ratings with %d delta "
+            "events (%d new segments, %d new tail lines)",
+            cache["vals"].size, dv.size, len(new_segments),
+            state["tail_lines"] - int(meta.get("tail_lines", 0)),
+        )
+        return td
+
+    def _save_cache(self, payload: dict, state: dict) -> None:
+        import json
+
+        npz_path, json_path = self._cache_paths()
+        os.makedirs(os.path.dirname(npz_path), exist_ok=True)
+        tmp = npz_path + ".tmp"
+        with open(tmp, "wb") as f:
+            np.savez(f, **payload)
+        os.replace(tmp, npz_path)
+        tmp = json_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"manifest": self._cache_manifest(), **state}, f)
+        os.replace(tmp, json_path)
+
+    def _read_training_columnar(self, ctx: WorkflowContext) -> TrainingData:
+        """Vectorized single-host read: the columnar bulk scan
+        (``PEventStore.find_columns``) plus numpy dedup/BiMap — no
+        per-event Python, which is what lets the FULL product path
+        (event store → template → ALS) keep up with the TPU at 10^7+
+        events (VERDICT r3 next-round #1). Semantics are identical to
+        :meth:`_read_ratings`: latest event per (user, item) wins, ties
+        break toward the higher rating, rate events must carry a numeric
+        ``rating`` property. On an append-only columnar store, repeat
+        trains read only the NEW segments/tail (see
+        :meth:`_try_incremental`)."""
+        from predictionio_tpu.data.storage import Storage
+        from predictionio_tpu.data.store import resolve_app
+
+        p = self.params
+        pe = Storage.get_p_events()
+        incremental_capable = p.incremental and hasattr(pe, "scan_state")
+        if incremental_capable:
+            app_id, _ = resolve_app(p.app_name)
+            try:
+                td = self._try_incremental(pe, app_id)
+                if td is not None:
+                    return td
+            except Exception:
+                logging.getLogger(__name__).warning(
+                    "Incremental re-index failed; falling back to a full "
+                    "read", exc_info=True,
+                )
+        if incremental_capable:
+            state = pe.scan_state(app_id)  # BEFORE the read: a concurrent
+            # append between read and state snapshot must invalidate, not
+            # silently count as already-consumed
+        cols = PEventStore.find_columns(
+            app_name=p.app_name,
+            entity_type="user",
+            event_names=[p.rate_event, p.buy_event],
+            prop="rating",
+            shard_index=ctx.host_index,
+            num_shards=ctx.num_hosts,
+        )
+        u_code, i_code, t_arr, v = self._extract_ratings_arrays(cols)
+        td, payload = self._assemble_training_data(
+            u_code, i_code, t_arr, v, cols.entity_vocab, cols.target_vocab
+        )
+        if incremental_capable:
+            try:
+                self._save_cache(payload, state)
+            except OSError:
+                logging.getLogger(__name__).warning(
+                    "Could not persist the training cache", exc_info=True
+                )
+        return td
 
     def read_training(self, ctx: WorkflowContext) -> TrainingData:
         if ctx.num_hosts > 1:

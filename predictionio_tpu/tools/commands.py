@@ -210,29 +210,64 @@ def import_events(
     channel: str | None = None,
     out: Out = _print,
 ) -> int:
-    """``pio import`` — JSON-lines file -> event store bulk write
+    """``pio import`` — JSON-lines file (or a columnar export directory,
+    auto-detected) -> event store bulk write
     (parity: ``tools/imprt/FileToEvents.scala``)."""
     from predictionio_tpu.data.store import resolve_app
 
     app_id, channel_id = resolve_app(app_name, channel)
     counter = {"n": 0}
 
-    def gen():
-        with open(input_path) as f:
-            for line_no, line in enumerate(f, 1):
-                line = line.strip()
-                if not line:
-                    continue
-                try:
-                    event = event_from_json(json.loads(line))
-                except Exception as e:
-                    raise StorageError(f"{input_path}:{line_no}: {e}") from e
+    if os.path.isdir(input_path):
+        # a `pio export --format columnar` directory: stream its events
+        # back through the portable object path (ids re-assigned by the
+        # destination store). Anything else directory-shaped (e.g. a
+        # --sharded JSONL export) must error, not silently import 0
+        # events — and must not be mutated by instantiating a driver on
+        # top of it.
+        if not os.path.isdir(os.path.join(input_path, "export_events")):
+            raise StorageError(
+                f"{input_path} is a directory but not a columnar export "
+                "(no export_events/ inside). For sharded JSONL exports, "
+                "import each shard file individually."
+            )
+        src = _columnar_file_client(input_path).get_p_events()
+
+        def gen():
+            for event in src.find(0):
                 counter["n"] += 1
-                yield event
+                yield event.with_event_id(None) if event.event_id else event
+
+    else:
+
+        def gen():
+            with open(input_path) as f:
+                for line_no, line in enumerate(f, 1):
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        event = event_from_json(json.loads(line))
+                    except Exception as e:
+                        raise StorageError(f"{input_path}:{line_no}: {e}") from e
+                    counter["n"] += 1
+                    yield event
 
     Storage.get_p_events().write(gen(), app_id, channel_id)
     out(f"Imported {counter['n']} events to app {app_name}.")
     return counter["n"]
+
+
+def _columnar_file_client(path: str):
+    """A throwaway columnar driver rooted at ``path`` — the on-disk
+    columnar interchange format IS the columnar store layout (the role
+    `--format parquet` plays for the reference's EventsToFile)."""
+    from predictionio_tpu.data.storage import columnar
+    from predictionio_tpu.data.storage.base import StorageClientConfig
+
+    return columnar.StorageClient(
+        StorageClientConfig("FILE", "columnar", {"path": path, "prefix": "export"})
+    )
 
 
 def export_events(
@@ -240,16 +275,38 @@ def export_events(
     output_path: str,
     channel: str | None = None,
     num_shards: int = 0,
+    format: str = "json",
     out: Out = _print,
 ) -> int:
-    """``pio export`` — event store -> JSON-lines file, or (with
-    ``num_shards > 0``) a directory of round-robin shard files for
-    multi-host training reads
+    """``pio export`` — event store -> JSON-lines file, a directory of
+    round-robin shard files (``num_shards > 0``, for multi-host training
+    reads), or a columnar segment directory (``format="columnar"`` — the
+    reference's ``--format parquet`` analog: dictionary-encoded, read
+    back at array speed)
     (parity: ``tools/export/EventsToFile.scala``)."""
     from predictionio_tpu.data.store import resolve_app
 
     app_id, channel_id = resolve_app(app_name, channel)
     events = Storage.get_p_events().find(app_id, channel_id)
+    if format == "columnar":
+        if num_shards > 0:
+            raise ValueError(
+                "--sharded applies to the JSON format only; a columnar "
+                "export is already a segment directory"
+            )
+        n = 0
+
+        def counted():
+            nonlocal n
+            for e in events:
+                n += 1
+                yield e
+
+        _columnar_file_client(output_path).get_p_events().write(counted(), 0)
+        out(f"Exported {n} events to columnar segments in {output_path}.")
+        return n
+    if format != "json":
+        raise ValueError(f"unknown export format {format!r} (json|columnar)")
     if num_shards > 0:
         from predictionio_tpu.parallel.reader import write_event_shards
 

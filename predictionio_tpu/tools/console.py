@@ -82,6 +82,14 @@ def build_parser() -> argparse.ArgumentParser:
         help="write N round-robin shard files into OUTPUT (a directory) "
         "for multi-host training reads",
     )
+    exp.add_argument(
+        "--format",
+        choices=("json", "columnar"),
+        default="json",
+        help="json = JSON-lines; columnar = dictionary-encoded segment "
+        "directory, re-importable and readable at array speed (the "
+        "reference's --format parquet role)",
+    )
 
     # ---- train
     train = sub.add_parser("train", help="run the training workflow")
@@ -326,7 +334,8 @@ def main(argv: list[str] | None = None) -> int:
             commands.import_events(args.appname, args.input, args.channel)
         elif cmd == "export":
             commands.export_events(
-                args.appname, args.output, args.channel, num_shards=args.sharded
+                args.appname, args.output, args.channel,
+                num_shards=args.sharded, format=args.format,
             )
         elif cmd == "train":
             from predictionio_tpu.parallel import initialize_from_env

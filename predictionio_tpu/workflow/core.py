@@ -137,14 +137,18 @@ def run_train(
                         engine_params, prev.id, blob.models
                     )
                     warm_from = prev.id
-                except ValueError as e:
-                    # e.g. the algorithm list changed since the predecessor
-                    # — a routine config change must not turn the retrain
-                    # flag into a hard failure
+                except Exception as e:
+                    # a changed algorithm list raises ValueError; a stale
+                    # pickle raises AttributeError/ModuleNotFoundError/
+                    # UnpicklingError — ANY hydration failure must fall
+                    # back to cold start, not turn the retrain flag into
+                    # a hard failure (and in multi-host, a crash here
+                    # would strand the other hosts at the consensus
+                    # allgather below)
                     logger.warning(
-                        "--warm-start: predecessor model %s is incompatible "
-                        "with the current engine params (%s); cold start",
-                        prev.id, e,
+                        "--warm-start: could not hydrate predecessor model "
+                        "%s (%s: %s); cold start",
+                        prev.id, type(e).__name__, e,
                     )
             else:
                 logger.warning(

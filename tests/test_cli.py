@@ -85,6 +85,50 @@ class TestImportExport:
         exported = [json.loads(l) for l in dst.read_text().splitlines()]
         assert {e["entityId"] for e in exported} == {str(u) for u in range(5)}
 
+    def test_columnar_format_round_trip(self, memory_storage_env, quiet, tmp_path):
+        """`pio export --format columnar` -> a segment directory that
+        `pio import` re-ingests (the reference's --format parquet role)."""
+        from predictionio_tpu.data.store import PEventStore
+
+        commands.app_new("appc", out=quiet.append)
+        src = tmp_path / "events.jsonl"
+        rows = [
+            {"event": "rate", "entityType": "user", "entityId": str(u),
+             "targetEntityType": "item", "targetEntityId": f"i{u % 3}",
+             "properties": {"rating": float(u % 5 + 1)},
+             "eventTime": f"2024-01-01T00:00:{u:02d}.000Z"}
+            for u in range(40)
+        ]
+        src.write_text("\n".join(json.dumps(e) for e in rows) + "\n")
+        assert commands.import_events("appc", str(src), out=quiet.append) == 40
+        coldir = tmp_path / "colexport"
+        assert commands.export_events(
+            "appc", str(coldir), format="columnar", out=quiet.append
+        ) == 40
+        assert any(
+            f.startswith("seg-") for _, _, fs in __import__("os").walk(coldir)
+            for f in fs
+        )
+        commands.app_new("appc2", out=quiet.append)
+        assert commands.import_events("appc2", str(coldir), out=quiet.append) == 40
+        got = sorted(
+            (e.entity_id, e.target_entity_id,
+             e.properties.get_as("rating", float))
+            for e in PEventStore.find(app_name="appc2")
+        )
+        want = sorted(
+            (r["entityId"], r["targetEntityId"], r["properties"]["rating"])
+            for r in rows
+        )
+        assert got == want
+
+    def test_export_unknown_format_rejected(self, memory_storage_env, quiet, tmp_path):
+        commands.app_new("appf", out=quiet.append)
+        with pytest.raises(ValueError, match="unknown export format"):
+            commands.export_events(
+                "appf", str(tmp_path / "x"), format="arrow", out=quiet.append
+            )
+
     def test_import_bad_line_reports_location(self, memory_storage_env, quiet, tmp_path):
         commands.app_new("app5", out=quiet.append)
         src = tmp_path / "bad.jsonl"

@@ -180,6 +180,7 @@ class TestTemplateColumnarRead:
 
         def setup(kind):
             env = {
+                "PIO_FS_BASEDIR": str(tmp_path / "base"),
                 "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
                 "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
                 "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
@@ -244,3 +245,134 @@ class TestTemplateColumnarRead:
             self._train_data_via(None, "columnar")
         with pytest.raises(Exception):
             self._train_data_via(None, "triples")
+
+
+class TestIncrementalReindex:
+    """Delta re-index on the append-only columnar store (SURVEY §8.3):
+    repeat trains read only NEW segments/tail; the merged result is
+    identical to a full re-read; any mutation that breaks the prefix
+    assumption (tombstones, store recreation) falls back to a full read."""
+
+    def _setup(self, tmp_path, monkeypatch):
+        from predictionio_tpu.data.storage import Storage
+        from predictionio_tpu.data.storage.base import App
+
+        monkeypatch.setenv("PIO_FS_BASEDIR", str(tmp_path / "base"))
+        Storage.configure(
+            {
+                "PIO_FS_BASEDIR": str(tmp_path / "base"),
+                "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+                "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+                "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+                "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "COL",
+                "PIO_STORAGE_SOURCES_COL_TYPE": "columnar",
+                "PIO_STORAGE_SOURCES_COL_PATH": str(tmp_path / "ev"),
+                "PIO_STORAGE_SOURCES_COL_SEGMENT_ROWS": "64",
+            }
+        )
+        app_id = Storage.get_meta_data_apps().insert(App(id=0, name="incapp"))
+        return app_id
+
+    def _td_sets(self, td):
+        return {
+            (
+                td.user_index.inverse(int(r)),
+                td.item_index.inverse(int(c)),
+                round(float(v), 5),
+            )
+            for r, c, v in zip(td.rows, td.cols, td.vals)
+        }
+
+    def _read(self, incremental=True):
+        from predictionio_tpu.controller.context import local_context
+        from predictionio_tpu.templates.recommendation.engine import (
+            DataSourceParams,
+            RecommendationDataSource,
+        )
+
+        ds = RecommendationDataSource(
+            DataSourceParams(app_name="incapp", incremental=incremental)
+        )
+        return ds._read_training_columnar(local_context())
+
+    def test_delta_merge_equals_full_read(self, tmp_path, monkeypatch):
+        from predictionio_tpu.data.storage import Storage
+        import predictionio_tpu.data.storage.columnar as colmod
+
+        app_id = self._setup(tmp_path, monkeypatch)
+        try:
+            pe = Storage.get_p_events()
+            pe.write(_mk_events(200, seed=1), app_id)
+            td1 = self._read()  # builds the cache
+
+            # new events arrive: bulk segments AND live tail inserts,
+            # including updates to EXISTING (user, item) pairs
+            pe.write(_mk_events(150, seed=2), app_id)
+            le = Storage.get_l_events()
+            for e in _mk_events(30, seed=3):
+                le.insert(e, app_id)
+
+            loads = []
+            orig = colmod._load_segment
+
+            def spy(path):
+                loads.append(path)
+                return orig(path)
+
+            monkeypatch.setattr(colmod, "_load_segment", spy)
+            # drop the decoded-segment cache so the spy sees real loads
+            Storage.get_l_events()._seg_cache.clear()
+            td_inc = self._read()  # incremental merge
+            inc_loads = len(loads)
+            loads.clear()
+            td_full = self._read(incremental=False)  # full re-read
+            full_loads = len(loads)
+            assert self._td_sets(td_inc) == self._td_sets(td_full)
+            assert len(td_inc.rows) == len(td_full.rows)
+            # the delta read must have touched FEWER segment files than
+            # the full read (only the post-cache segments)
+            assert 0 < inc_loads < full_loads, (inc_loads, full_loads)
+            assert len(td_inc.rows) > len(td1.rows)
+            # unchanged store: the fast path reuses the cache and loads
+            # ZERO segment files
+            loads.clear()
+            Storage.get_l_events()._seg_cache.clear()
+            td_again = self._read()
+            assert self._td_sets(td_again) == self._td_sets(td_full)
+            assert len(loads) == 0, loads
+        finally:
+            Storage.configure(None)
+
+    def test_tombstone_invalidates_cache(self, tmp_path, monkeypatch):
+        from predictionio_tpu.data.storage import Storage
+
+        app_id = self._setup(tmp_path, monkeypatch)
+        try:
+            pe = Storage.get_p_events()
+            pe.write(_mk_events(120, seed=5), app_id)
+            self._read()  # cache
+            le = Storage.get_l_events()
+            victim = next(iter(le.find(app_id, event_names=["rate"])))
+            assert le.delete(victim.event_id, app_id)
+            td_inc = self._read()
+            td_full = self._read(incremental=False)
+            assert self._td_sets(td_inc) == self._td_sets(td_full)
+        finally:
+            Storage.configure(None)
+
+    def test_store_recreation_invalidates_cache(self, tmp_path, monkeypatch):
+        from predictionio_tpu.data.storage import Storage
+
+        app_id = self._setup(tmp_path, monkeypatch)
+        try:
+            pe = Storage.get_p_events()
+            pe.write(_mk_events(100, seed=6), app_id)
+            self._read()  # cache against the first incarnation
+            pe.delete(app_id)  # drop + recreate the stream
+            pe.write(_mk_events(80, seed=7), app_id)
+            td_inc = self._read()
+            td_full = self._read(incremental=False)
+            assert self._td_sets(td_inc) == self._td_sets(td_full)
+            assert len(td_inc.rows) <= 80
+        finally:
+            Storage.configure(None)

@@ -67,6 +67,7 @@ class TestQuickstartParity:
         u, i, r, tr, te, source = split
         Storage.configure(
             {
+                "PIO_FS_BASEDIR": str(tmp_path / "base"),
                 "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
                 "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
                 "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
