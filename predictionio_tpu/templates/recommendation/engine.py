@@ -301,14 +301,19 @@ class RecommendationDataSource(DataSource):
     # ---------------------------------------------------- incremental cache
     def _cache_paths(self) -> tuple[str, str]:
         import re
+        import zlib
 
         from predictionio_tpu.data.storage import Storage
 
-        safe = re.sub(r"[^A-Za-z0-9_-]", "_", self.params.app_name)
+        # the readable prefix is sanitized; the crc suffix keeps distinct
+        # app names (e.g. "my/app" vs "my_app") from sharing a cache file
+        name = self.params.app_name
+        safe = re.sub(r"[^A-Za-z0-9_-]", "_", name)
+        tag = f"{safe}-{zlib.crc32(name.encode()):08x}"
         base = os.path.join(Storage.base_dir(), "train_cache")
         return (
-            os.path.join(base, f"{safe}.npz"),
-            os.path.join(base, f"{safe}.json"),
+            os.path.join(base, f"{tag}.npz"),
+            os.path.join(base, f"{tag}.json"),
         )
 
     def _cache_manifest(self) -> dict:
@@ -457,7 +462,12 @@ class RecommendationDataSource(DataSource):
 
         p = self.params
         pe = Storage.get_p_events()
-        incremental_capable = p.incremental and hasattr(pe, "scan_state")
+        # cache only whole-store reads: a sharded (multi-host) read would
+        # record the full manifest against one shard's data and poison
+        # later single-host trains
+        incremental_capable = (
+            p.incremental and hasattr(pe, "scan_state") and ctx.num_hosts == 1
+        )
         if incremental_capable:
             app_id, _ = resolve_app(p.app_name)
             try:

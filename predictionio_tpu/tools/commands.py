@@ -294,6 +294,14 @@ def export_events(
                 "--sharded applies to the JSON format only; a columnar "
                 "export is already a segment directory"
             )
+        if os.path.isdir(os.path.join(output_path, "export_events")):
+            # appending segments to a previous export would duplicate
+            # every event on re-import (JSON exports overwrite; refuse
+            # rather than silently differ)
+            raise StorageError(
+                f"{output_path} already holds a columnar export; remove it "
+                "or export to a fresh directory"
+            )
         n = 0
 
         def counted():
