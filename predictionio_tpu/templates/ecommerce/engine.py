@@ -93,8 +93,57 @@ class ECommerceDataSource(DataSource):
     def __init__(self, params: DataSourceParams):
         super().__init__(params)
 
+    def _read_categories(self) -> dict[str, tuple]:
+        categories: dict[str, tuple] = {}
+        for item_id, pm in PEventStore.aggregate_properties(
+            app_name=self.params.app_name,
+            entity_type=self.params.item_entity_type,
+        ).items():
+            categories[item_id] = tuple(
+                str(c) for c in pm.opt("categories", list, [])
+            )
+        return categories
+
+    def _read_training_columnar(self, ctx: WorkflowContext) -> TrainingData:
+        """Vectorized single-host read: columnar bulk scan + grouped
+        weighted sums (a buy is a much stronger signal than a view) —
+        no per-event Python at 10^7+ events."""
+        from predictionio_tpu.templates.columnar_util import (
+            aggregate_pairs,
+            densify_pairs,
+            event_name_mask,
+        )
+
+        p = self.params
+        cols_batch = PEventStore.find_columns(
+            app_name=p.app_name, event_names=[p.view_event, p.buy_event]
+        )
+        weights = np.ones(len(cols_batch), np.float32)
+        weights[event_name_mask(cols_batch, p.buy_event)] = 5.0
+        u_sel, i_sel, vals = aggregate_pairs(cols_batch, weights)
+        categories = self._read_categories()
+        rows, cols_idx, user_vocab, item_vocab = densify_pairs(
+            cols_batch, u_sel, i_sel, extra_items=categories
+        )
+        item_index = BiMap.from_dict(
+            dict(zip(item_vocab, range(len(item_vocab))))
+        )
+        popularity = np.zeros(len(item_index), dtype=np.float32)
+        np.add.at(popularity, cols_idx, vals)
+        return TrainingData(
+            rows,
+            cols_idx,
+            vals,
+            BiMap.from_dict(dict(zip(user_vocab, range(len(user_vocab))))),
+            item_index,
+            categories,
+            popularity,
+        )
+
     def read_training(self, ctx: WorkflowContext) -> TrainingData:
         p = self.params
+        if ctx.num_hosts == 1:
+            return self._read_training_columnar(ctx)
         counts: dict[tuple[str, str], float] = {}
         for e in PEventStore.find(
             app_name=p.app_name,
@@ -108,41 +157,26 @@ class ECommerceDataSource(DataSource):
             weight = 5.0 if e.event == p.buy_event else 1.0
             key = (e.entity_id, e.target_entity_id)
             counts[key] = counts.get(key, 0.0) + weight
-        categories: dict[str, tuple] = {}
-        for item_id, pm in PEventStore.aggregate_properties(
-            app_name=p.app_name, entity_type=p.item_entity_type
-        ).items():
-            categories[item_id] = tuple(
-                str(c) for c in pm.opt("categories", list, [])
-            )
-        if ctx.num_hosts > 1:
-            # cross-host coherence (round-1 advisor high finding): merge
-            # per-host weighted counts, build identical global BiMaps, and
-            # sum popularity across hosts
-            import operator
+        categories = self._read_categories()
+        # cross-host coherence (round-1 advisor high finding): merge
+        # per-host weighted counts, build identical global BiMaps, and
+        # sum popularity across hosts
+        import operator
 
-            from predictionio_tpu.parallel.exchange import global_vocab, merge_keyed
+        from predictionio_tpu.parallel.exchange import global_sum_array, global_vocab, merge_keyed
 
-            counts = merge_keyed(counts, combine=operator.add)
-            user_index = BiMap.string_index(global_vocab(u for u, _ in counts))
-            item_index = BiMap.string_index(
-                global_vocab(list(i for _, i in counts) + list(categories))
-            )
-        else:
-            user_index = BiMap.string_index(u for u, _ in counts)
-            item_index = BiMap.string_index(
-                list(i for _, i in counts) + list(categories)
-            )
+        counts = merge_keyed(counts, combine=operator.add)
+        user_index = BiMap.string_index(global_vocab(u for u, _ in counts))
+        item_index = BiMap.string_index(
+            global_vocab(list(i for _, i in counts) + list(categories))
+        )
         n = len(counts)
         rows = np.fromiter((user_index[u] for u, _ in counts), np.int64, n)
         cols = np.fromiter((item_index[i] for _, i in counts), np.int64, n)
         vals = np.fromiter(counts.values(), np.float32, n)
         popularity = np.zeros(len(item_index), dtype=np.float32)
         np.add.at(popularity, cols, vals)
-        if ctx.num_hosts > 1:
-            from predictionio_tpu.parallel.exchange import global_sum_array
-
-            popularity = global_sum_array(popularity)
+        popularity = global_sum_array(popularity)
         return TrainingData(
             rows, cols, vals, user_index, item_index, categories, popularity
         )

@@ -194,11 +194,12 @@ class RecommendationDataSource(DataSource):
         numeric rating (same error semantics as the event-stream path)."""
         from predictionio_tpu.data.event import EventValidationError
 
+        from predictionio_tpu.templates.columnar_util import event_name_mask
+
         p = self.params
-        is_buy = np.zeros(len(cols), dtype=bool)
-        bi = np.searchsorted(cols.event_vocab, p.buy_event)
-        if bi < cols.event_vocab.size and cols.event_vocab[bi] == p.buy_event:
-            is_buy = cols.event_code == bi
+        # exact-match lookup: a third-party driver's event_vocab need not
+        # be sorted (the EventColumns contract doesn't promise it)
+        is_buy = event_name_mask(cols, p.buy_event)
         if is_buy.any():
             vals = np.where(is_buy, np.float32(p.buy_rating), cols.prop)
         else:

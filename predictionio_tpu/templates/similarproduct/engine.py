@@ -84,8 +84,56 @@ class SimilarProductDataSource(DataSource):
     def __init__(self, params: DataSourceParams):
         super().__init__(params)
 
+    def _read_categories(self) -> dict[str, tuple]:
+        """$set-only items included so catalog filters work for unviewed
+        items."""
+        categories: dict[str, tuple] = {}
+        item_props = PEventStore.aggregate_properties(
+            app_name=self.params.app_name,
+            entity_type=self.params.item_entity_type,
+        )
+        for item_id, pm in item_props.items():
+            cats = pm.opt("categories", list, [])
+            categories[item_id] = tuple(str(c) for c in cats)
+        return categories
+
+    def _read_training_columnar(self, ctx: WorkflowContext) -> TrainingData:
+        """Vectorized single-host read: columnar bulk scan + numpy
+        per-pair view counting — the same no-per-event-Python path the
+        recommendation template takes (VERDICT r3 next-round #1), with
+        sum aggregation instead of latest-wins."""
+        from predictionio_tpu.templates.columnar_util import (
+            aggregate_pairs,
+            densify_pairs,
+        )
+
+        p = self.params
+        cols = PEventStore.find_columns(
+            app_name=p.app_name, event_names=[p.view_event]
+        )
+        u_sel, i_sel, counts = aggregate_pairs(cols)
+        # user vocab: viewed users only; item vocab: viewed + $set-only
+        categories = self._read_categories()
+        rows, cols_idx, user_vocab, item_vocab = densify_pairs(
+            cols, u_sel, i_sel, extra_items=categories
+        )
+        return TrainingData(
+            rows=rows,
+            cols=cols_idx,
+            vals=counts,
+            user_index=BiMap.from_dict(
+                dict(zip(user_vocab, range(len(user_vocab))))
+            ),
+            item_index=BiMap.from_dict(
+                dict(zip(item_vocab, range(len(item_vocab))))
+            ),
+            categories=categories,
+        )
+
     def read_training(self, ctx: WorkflowContext) -> TrainingData:
         p = self.params
+        if ctx.num_hosts == 1:
+            return self._read_training_columnar(ctx)
         counts: dict[tuple[str, str], float] = {}
         for e in PEventStore.find(
             app_name=p.app_name,
@@ -97,32 +145,19 @@ class SimilarProductDataSource(DataSource):
                 continue
             key = (e.entity_id, e.target_entity_id)
             counts[key] = counts.get(key, 0.0) + 1.0
-        # include $set-only items so catalog filters work for unviewed items
-        categories: dict[str, tuple] = {}
-        item_props = PEventStore.aggregate_properties(
-            app_name=p.app_name, entity_type=p.item_entity_type
+        categories = self._read_categories()
+        # cross-host coherence (round-1 advisor high finding): merge
+        # per-host view counts by user, then build IDENTICAL global
+        # BiMaps on every host from sorted vocabularies
+        import operator
+
+        from predictionio_tpu.parallel.exchange import global_vocab, merge_keyed
+
+        counts = merge_keyed(counts, combine=operator.add)
+        user_index = BiMap.string_index(global_vocab(u for u, _ in counts))
+        item_index = BiMap.string_index(
+            global_vocab(list(i for _, i in counts) + list(categories))
         )
-        for item_id, pm in item_props.items():
-            cats = pm.opt("categories", list, [])
-            categories[item_id] = tuple(str(c) for c in cats)
-        if ctx.num_hosts > 1:
-            # cross-host coherence (round-1 advisor high finding): merge
-            # per-host view counts by user, then build IDENTICAL global
-            # BiMaps on every host from sorted vocabularies
-            import operator
-
-            from predictionio_tpu.parallel.exchange import global_vocab, merge_keyed
-
-            counts = merge_keyed(counts, combine=operator.add)
-            user_index = BiMap.string_index(global_vocab(u for u, _ in counts))
-            item_index = BiMap.string_index(
-                global_vocab(list(i for _, i in counts) + list(categories))
-            )
-        else:
-            user_index = BiMap.string_index(u for u, _ in counts)
-            item_index = BiMap.string_index(
-                list(i for _, i in counts) + list(categories)
-            )
         n = len(counts)
         rows = np.fromiter((user_index[u] for u, _ in counts), np.int64, n)
         cols = np.fromiter((item_index[i] for _, i in counts), np.int64, n)

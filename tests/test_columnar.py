@@ -376,3 +376,148 @@ class TestIncrementalReindex:
             assert len(td_inc.rows) <= 80
         finally:
             Storage.configure(None)
+
+
+class TestSimilarProductColumnarRead:
+    def test_vectorized_counts_match_event_stream(self, tmp_path):
+        """The similar-product template's vectorized view-count read must
+        equal the per-event dict aggregation on identical events
+        (including $set-only catalog items)."""
+        from predictionio_tpu.controller.context import local_context
+        from predictionio_tpu.data.storage import Storage
+        from predictionio_tpu.data.storage.base import App
+        from predictionio_tpu.templates.similarproduct.engine import (
+            DataSourceParams,
+            SimilarProductDataSource,
+        )
+
+        Storage.configure(
+            {
+                "PIO_FS_BASEDIR": str(tmp_path / "base"),
+                "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+                "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+                "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+                "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "COL",
+                "PIO_STORAGE_SOURCES_COL_TYPE": "columnar",
+                "PIO_STORAGE_SOURCES_COL_PATH": str(tmp_path / "ev"),
+                "PIO_STORAGE_SOURCES_COL_SEGMENT_ROWS": "77",
+            }
+        )
+        try:
+            app_id = Storage.get_meta_data_apps().insert(App(id=0, name="spapp"))
+            rng = np.random.default_rng(8)
+            events = []
+            for _ in range(600):
+                events.append(
+                    Event(
+                        event="view", entity_type="user",
+                        entity_id=f"u{rng.integers(0, 30)}",
+                        target_entity_type="item",
+                        target_entity_id=f"i{rng.integers(0, 15)}",
+                    )
+                )
+            # catalog items never viewed, carrying categories
+            for k in range(3):
+                events.append(
+                    Event(
+                        event="$set", entity_type="item",
+                        entity_id=f"cold{k}",
+                        properties=DataMap({"categories": ["c1"]}),
+                    )
+                )
+            Storage.get_p_events().write(events, app_id)
+
+            ds = SimilarProductDataSource(DataSourceParams(app_name="spapp"))
+            ctx = local_context()
+            td_fast = ds._read_training_columnar(ctx)
+
+            # reference aggregation: plain dict over the event stream
+            from predictionio_tpu.data.store import PEventStore
+
+            counts = {}
+            for e in PEventStore.find(app_name="spapp", event_names=["view"]):
+                key = (e.entity_id, e.target_entity_id)
+                counts[key] = counts.get(key, 0.0) + 1.0
+            got = {
+                (
+                    td_fast.user_index.inverse(int(r)),
+                    td_fast.item_index.inverse(int(c)),
+                ): float(v)
+                for r, c, v in zip(td_fast.rows, td_fast.cols, td_fast.vals)
+            }
+            assert got == counts
+            # $set-only items are in the index (for catalog filters)
+            for k in range(3):
+                assert f"cold{k}" in td_fast.item_index
+            assert td_fast.categories["cold0"] == ("c1",)
+        finally:
+            Storage.configure(None)
+
+
+class TestECommerceColumnarRead:
+    def test_vectorized_weighted_counts_match_event_stream(self, tmp_path):
+        """The e-commerce template's vectorized weighted aggregation
+        (buy=5, view=1) must equal the per-event dict path, incl. the
+        popularity vector."""
+        from predictionio_tpu.controller.context import local_context
+        from predictionio_tpu.data.storage import Storage
+        from predictionio_tpu.data.storage.base import App
+        from predictionio_tpu.templates.ecommerce.engine import (
+            DataSourceParams,
+            ECommerceDataSource,
+        )
+
+        Storage.configure(
+            {
+                "PIO_FS_BASEDIR": str(tmp_path / "base"),
+                "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+                "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+                "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+                "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "COL",
+                "PIO_STORAGE_SOURCES_COL_TYPE": "columnar",
+                "PIO_STORAGE_SOURCES_COL_PATH": str(tmp_path / "ev"),
+                "PIO_STORAGE_SOURCES_COL_SEGMENT_ROWS": "53",
+            }
+        )
+        try:
+            app_id = Storage.get_meta_data_apps().insert(App(id=0, name="ecapp"))
+            rng = np.random.default_rng(12)
+            events = []
+            for _ in range(500):
+                kind = "buy" if rng.random() < 0.3 else "view"
+                events.append(
+                    Event(
+                        event=kind, entity_type="user",
+                        entity_id=f"u{rng.integers(0, 25)}",
+                        target_entity_type="item",
+                        target_entity_id=f"i{rng.integers(0, 12)}",
+                    )
+                )
+            Storage.get_p_events().write(events, app_id)
+
+            ds = ECommerceDataSource(DataSourceParams(app_name="ecapp"))
+            td = ds._read_training_columnar(local_context())
+
+            from predictionio_tpu.data.store import PEventStore
+
+            want = {}
+            for e in PEventStore.find(app_name="ecapp", event_names=["view", "buy"]):
+                w = 5.0 if e.event == "buy" else 1.0
+                key = (e.entity_id, e.target_entity_id)
+                want[key] = want.get(key, 0.0) + w
+            got = {
+                (
+                    td.user_index.inverse(int(r)),
+                    td.item_index.inverse(int(c)),
+                ): float(v)
+                for r, c, v in zip(td.rows, td.cols, td.vals)
+            }
+            assert got == want
+            # popularity = per-item weighted totals
+            for item, pop in (
+                ("i0", None), ("i5", None),
+            ):
+                expect = sum(v for (u, i), v in want.items() if i == item)
+                assert float(td.popularity[td.item_index[item]]) == expect
+        finally:
+            Storage.configure(None)
