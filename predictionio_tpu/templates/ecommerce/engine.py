@@ -125,16 +125,14 @@ class ECommerceDataSource(DataSource):
         rows, cols_idx, user_vocab, item_vocab = densify_pairs(
             cols_batch, u_sel, i_sel, extra_items=categories
         )
-        item_index = BiMap.from_dict(
-            dict(zip(item_vocab, range(len(item_vocab))))
-        )
+        item_index = BiMap.string_index(item_vocab)
         popularity = np.zeros(len(item_index), dtype=np.float32)
         np.add.at(popularity, cols_idx, vals)
         return TrainingData(
             rows,
             cols_idx,
             vals,
-            BiMap.from_dict(dict(zip(user_vocab, range(len(user_vocab))))),
+            BiMap.string_index(user_vocab),
             item_index,
             categories,
             popularity,

@@ -282,12 +282,8 @@ class RecommendationDataSource(DataSource):
             rows=rows,
             cols=cols_idx,
             vals=v_sel,
-            user_index=BiMap.from_dict(
-                dict(zip(user_list, range(len(user_list))))
-            ),
-            item_index=BiMap.from_dict(
-                dict(zip(item_list, range(len(item_list))))
-            ),
+            user_index=BiMap.string_index(user_list),
+            item_index=BiMap.string_index(item_list),
         )
         cache_payload = {
             "u_code": rows.astype(np.int32),
@@ -392,12 +388,8 @@ class RecommendationDataSource(DataSource):
                 rows=cache["u_code"].astype(np.int64),
                 cols=cache["i_code"].astype(np.int64),
                 vals=cache["vals"],
-                user_index=BiMap.from_dict(
-                    dict(zip(user_list, range(len(user_list))))
-                ),
-                item_index=BiMap.from_dict(
-                    dict(zip(item_list, range(len(item_list))))
-                ),
+                user_index=BiMap.string_index(user_list),
+                item_index=BiMap.string_index(item_list),
             )
         # unify vocabularies (cache vocab is exactly its used ids; du is
         # non-empty past the early return above, so the delta vocabs are

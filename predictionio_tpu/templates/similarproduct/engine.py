@@ -121,12 +121,8 @@ class SimilarProductDataSource(DataSource):
             rows=rows,
             cols=cols_idx,
             vals=counts,
-            user_index=BiMap.from_dict(
-                dict(zip(user_vocab, range(len(user_vocab))))
-            ),
-            item_index=BiMap.from_dict(
-                dict(zip(item_vocab, range(len(item_vocab))))
-            ),
+            user_index=BiMap.string_index(user_vocab),
+            item_index=BiMap.string_index(item_vocab),
             categories=categories,
         )
 

@@ -124,6 +124,21 @@ class TwoTowerDataSource(DataSource):
         partitioned (per-host) merge would be incoherent; pairs are two
         ids each, small next to the raw events they dedup."""
         p = self.params
+        if ctx.num_hosts == 1:
+            # columnar fast path: dedup happens over code arrays, so the
+            # remaining Python is O(distinct pairs), not O(events)
+            from predictionio_tpu.templates.columnar_util import aggregate_pairs
+
+            cols = PEventStore.find_columns(
+                app_name=p.app_name, event_names=list(p.event_names)
+            )
+            u_sel, i_sel, _ = aggregate_pairs(cols)
+            return sorted(
+                zip(
+                    cols.entity_vocab[u_sel].tolist(),
+                    cols.target_vocab[i_sel].tolist(),
+                )
+            )
         pairs: dict[tuple[str, str], bool] = {}
         for e in PEventStore.find(
             app_name=p.app_name,
@@ -155,9 +170,36 @@ class TwoTowerDataSource(DataSource):
             seen.setdefault(u, set()).add(i)
         return TrainingData(rows, cols, user_index, item_index, seen)
 
+    def _read_training_columnar(self, ctx: WorkflowContext) -> TrainingData:
+        """Vectorized single-host read: columnar bulk scan + grouped pair
+        dedup (in-batch softmax has no per-pair weight, so a distinct-
+        pair set is the right shape) — no per-event Python. The seen-
+        filter dict is built from the (much smaller) deduped pair set."""
+        from predictionio_tpu.templates.columnar_util import (
+            aggregate_pairs,
+            densify_pairs,
+        )
+
+        p = self.params
+        cols = PEventStore.find_columns(
+            app_name=p.app_name, event_names=list(p.event_names)
+        )
+        u_sel, i_sel, _counts = aggregate_pairs(cols)
+        rows, cols_idx, user_vocab, item_vocab = densify_pairs(
+            cols, u_sel, i_sel
+        )
+        user_index = BiMap.string_index(user_vocab)
+        item_index = BiMap.string_index(item_vocab)
+        seen: dict[str, set] = {}
+        for r, c in zip(rows.tolist(), cols_idx.tolist()):
+            seen.setdefault(user_vocab[r], set()).add(item_vocab[c])
+        return TrainingData(rows, cols_idx, user_index, item_index, seen)
+
     def read_training(self, ctx: WorkflowContext) -> TrainingData:
         # training consumes distinct (user, item) PAIRS — in-batch softmax
         # has no per-pair weight, so a set (not counts) is the right shape
+        if ctx.num_hosts == 1:
+            return self._read_training_columnar(ctx)
         return self._to_training_data(self._read_pairs(ctx))
 
     def read_eval(self, ctx: WorkflowContext):

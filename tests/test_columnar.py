@@ -521,3 +521,61 @@ class TestECommerceColumnarRead:
                 assert float(td.popularity[td.item_index[item]]) == expect
         finally:
             Storage.configure(None)
+
+
+class TestTwoTowerColumnarRead:
+    def test_vectorized_pairs_match_event_stream(self, tmp_path):
+        """The two-tower template's vectorized distinct-pair read must
+        equal the per-event dict path, including the seen-filter."""
+        from predictionio_tpu.controller.context import local_context
+        from predictionio_tpu.data.storage import Storage
+        from predictionio_tpu.data.storage.base import App
+        from predictionio_tpu.templates.twotower.engine import (
+            DataSourceParams,
+            TwoTowerDataSource,
+        )
+
+        Storage.configure(
+            {
+                "PIO_FS_BASEDIR": str(tmp_path / "base"),
+                "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+                "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+                "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+                "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "COL",
+                "PIO_STORAGE_SOURCES_COL_TYPE": "columnar",
+                "PIO_STORAGE_SOURCES_COL_PATH": str(tmp_path / "ev"),
+                "PIO_STORAGE_SOURCES_COL_SEGMENT_ROWS": "61",
+            }
+        )
+        try:
+            app_id = Storage.get_meta_data_apps().insert(App(id=0, name="ttapp"))
+            rng = np.random.default_rng(4)
+            Storage.get_p_events().write(
+                [
+                    Event(
+                        event=str(rng.choice(["view", "buy"])),
+                        entity_type="user",
+                        entity_id=f"u{rng.integers(0, 20)}",
+                        target_entity_type="item",
+                        target_entity_id=f"i{rng.integers(0, 14)}",
+                    )
+                    for _ in range(400)
+                ],
+                app_id,
+            )
+            ds = TwoTowerDataSource(DataSourceParams(app_name="ttapp"))
+            ctx = local_context()
+            td_fast = ds._read_training_columnar(ctx)
+            td_slow = ds._to_training_data(ds._read_pairs(ctx))
+            fast = {
+                (td_fast.user_index.inverse(int(r)), td_fast.item_index.inverse(int(c)))
+                for r, c in zip(td_fast.rows, td_fast.cols)
+            }
+            slow = {
+                (td_slow.user_index.inverse(int(r)), td_slow.item_index.inverse(int(c)))
+                for r, c in zip(td_slow.rows, td_slow.cols)
+            }
+            assert fast == slow and len(td_fast.rows) == len(td_slow.rows)
+            assert td_fast.seen == td_slow.seen
+        finally:
+            Storage.configure(None)
