@@ -641,6 +641,63 @@ def _bench_serving(n_requests: int) -> dict:
             out["device_path"] = run_one(True)
         except Exception as e:  # device path must not sink the whole bench
             out["device_path"] = {"error": str(e)[:200]}
+
+        # --- event-server ingest over real HTTP (the 7070 hot loop) -----
+        from predictionio_tpu.api import EventService
+        from predictionio_tpu.data.storage.base import AccessKey
+
+        key = "bench-ingest-key"
+        Storage.get_meta_data_access_keys().insert(
+            AccessKey(key=key, appid=app_id, events=[])
+        )
+        es_server, _ = start_background(
+            EventService().dispatch, host="127.0.0.1", port=0
+        )
+        try:
+            es_port = es_server.server_address[1]
+            es_url = (
+                f"http://127.0.0.1:{es_port}/events.json?accessKey={key}"
+            )
+            n_ev = 2000
+            bodies = [
+                json.dumps(
+                    {
+                        "event": "rate",
+                        "entityType": "user",
+                        "entityId": str(int(u)),
+                        "targetEntityType": "item",
+                        "targetEntityId": str(int(i)),
+                        "properties": {"rating": 4.0},
+                    }
+                ).encode()
+                for u, i in zip(
+                    rng.integers(0, num_users, n_ev),
+                    rng.integers(0, num_items, n_ev),
+                )
+            ]
+            def post(body: bytes) -> None:
+                urllib.request.urlopen(
+                    urllib.request.Request(
+                        es_url, data=body,
+                        headers={"Content-Type": "application/json"},
+                    ),
+                    timeout=30,
+                ).read()
+
+            for body in bodies[:50]:  # warm-up
+                post(body)
+            t0 = time.perf_counter()
+            for body in bodies[50:]:
+                post(body)
+            dt = time.perf_counter() - t0
+            out["event_ingest_http"] = {
+                "events_per_sec": round((n_ev - 50) / dt, 1),
+                "requests": n_ev - 50,
+                "note": "single-threaded client, one event per POST",
+            }
+        finally:
+            es_server.shutdown()
+            es_server.server_close()
         return out
     finally:
         Storage.configure(None)

@@ -133,6 +133,26 @@ class RecommendationDataSource(DataSource):
         super().__init__(params)
 
     def _read_ratings(self, ctx: WorkflowContext) -> list[tuple[str, str, float]]:
+        if ctx.num_hosts == 1:
+            # columnar fast path (read_eval's input): the vectorized read
+            # dedups over code arrays, so the remaining Python is
+            # O(distinct pairs), not O(events)
+            td = self._read_training_columnar(ctx)
+            users = td.user_index.keys()
+            items = td.item_index.keys()
+            return [
+                (users[r], items[c], float(v))
+                for r, c, v in zip(
+                    td.rows.tolist(), td.cols.tolist(), td.vals.tolist()
+                )
+            ]
+        return self._read_ratings_stream(ctx)
+
+    def _read_ratings_stream(
+        self, ctx: WorkflowContext
+    ) -> list[tuple[str, str, float]]:
+        """The per-event reference path (multi-host coherence, and the
+        behavioral oracle the columnar path is tested against)."""
         p = self.params
         ratings: dict[tuple[str, str], tuple[Any, float]] = {}
         events = PEventStore.find(
@@ -165,7 +185,12 @@ class RecommendationDataSource(DataSource):
             from predictionio_tpu.parallel.exchange import merge_keyed
 
             ratings = merge_keyed(ratings, combine=max)
-        return [(u, i, r) for (u, i), (_, r) in ratings.items()]
+        # float32, matching training precision AND the columnar fast path
+        # (a float64 here could land on the other side of read_eval's 3.5
+        # positives cutoff than the same rating read columnar)
+        return [
+            (u, i, float(np.float32(r))) for (u, i), (_, r) in ratings.items()
+        ]
 
     @staticmethod
     def _to_training_data(
@@ -445,7 +470,9 @@ class RecommendationDataSource(DataSource):
         per-event Python, which is what lets the FULL product path
         (event store → template → ALS) keep up with the TPU at 10^7+
         events (VERDICT r3 next-round #1). Semantics are identical to
-        :meth:`_read_ratings`: latest event per (user, item) wins, ties
+        :meth:`_read_ratings_stream` (the per-event oracle the
+        equivalence tests compare against): latest event per (user,
+        item) wins, ties
         break toward the higher rating, rate events must carry a numeric
         ``rating`` property. On an append-only columnar store, repeat
         trains read only the NEW segments/tail (see

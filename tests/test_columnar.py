@@ -169,7 +169,7 @@ class TestTemplateColumnarRead:
         ctx = local_context()
         if path_kind == "columnar":
             return ds._read_training_columnar(ctx)
-        return ds._to_training_data(ds._read_ratings(ctx), ctx)
+        return ds._to_training_data(ds._read_ratings_stream(ctx), ctx)
 
     @pytest.fixture()
     def app_on(self, tmp_path):
