@@ -62,12 +62,17 @@ def _sweep_flops(nnz: int, num_users: int, num_items: int, rank: int) -> float:
 
 
 def _sync_buckets(jnp, b) -> None:
-    """Hard sync: force materialization of every bucket array via a tiny
-    host read (block_until_ready can be unreliable through
-    remote-execution platforms)."""
+    """Hard sync: force materialization of every bucket array via ONE
+    fused host read (block_until_ready can be unreliable through
+    remote-execution platforms, and a per-array read would charge one
+    network RTT per chunk to the bucketing measurement — ~50 RTTs of
+    pure tunnel latency masquerading as device time)."""
+    parts = []
     for ch in list(b.normal) + list(b.hot):
-        float(jnp.sum(ch.idx.ravel()[:1]))
-        float(jnp.sum(ch.val.ravel()[:1]))
+        parts.append(jnp.sum(ch.idx.ravel()[:1]).astype(jnp.float32))
+        parts.append(jnp.sum(ch.val.ravel()[:1]))
+    if parts:
+        float(sum(parts))
 
 
 def _time_training(rows, cols, vals, num_users, num_items, rank, iters,
@@ -642,8 +647,25 @@ def _bench_serving(n_requests: int) -> dict:
         except Exception as e:  # device path must not sink the whole bench
             out["device_path"] = {"error": str(e)[:200]}
 
-        # --- event-server ingest over real HTTP (the 7070 hot loop) -----
+        # --- event-server ingest over real HTTP (the 7070 hot loop).
+        # Failure here must not discard the already-measured latency
+        # numbers (same convention as the device path above).
+        try:
+            out["event_ingest_http"] = _bench_event_ingest(
+                Storage, app_id, rng, num_users, num_items
+            )
+        except Exception as e:
+            out["event_ingest_http"] = {"error": str(e)[:200]}
+        return out
+    finally:
+        Storage.configure(None)
+
+
+def _bench_event_ingest(Storage, app_id, rng, num_users, num_items) -> dict:
+        import urllib.request
+
         from predictionio_tpu.api import EventService
+        from predictionio_tpu.api.http import start_background
         from predictionio_tpu.data.storage.base import AccessKey
 
         key = "bench-ingest-key"
