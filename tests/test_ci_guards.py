@@ -1,0 +1,95 @@
+"""CI guards that make a never-executed commit unshippable.
+
+Round-4 postmortem (VERDICT r4 weak #1): the end-of-round commit shipped a
+``bench.py`` that did not even parse, which killed the driver's official
+benchmark capture AND failed the suite via an import. Two guards prevent a
+recurrence:
+
+1. every tracked ``*.py`` file must ``ast.parse`` (catches syntax errors in
+   files nothing imports, e.g. scripts and entry points);
+2. ``python bench.py --smoke`` must run end-to-end on CPU and print one
+   valid JSON line with every bench section populated (catches runtime
+   breakage in the bench itself — scoping bugs, renamed imports — that a
+   parse check cannot see).
+
+Reference analog: the upstream repo's CI compiles every module as part of
+``sbt test`` (SURVEY.md section 5), so an unparseable source could never
+ship there either.
+"""
+
+import ast
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _tracked_py_files():
+    out = subprocess.run(
+        ["git", "ls-files", "*.py"],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        check=True,
+    )
+    files = [f for f in out.stdout.splitlines() if f.strip()]
+    assert files, "git ls-files returned no python files — guard is broken"
+    return files
+
+
+def test_every_tracked_python_file_parses():
+    tracked = _tracked_py_files()
+    bad = []
+    for rel in tracked:
+        path = os.path.join(REPO, rel)
+        try:
+            with open(path, "rb") as fh:
+                ast.parse(fh.read(), filename=rel)
+        except SyntaxError as e:
+            bad.append(f"{rel}: {e}")
+    assert not bad, "unparseable tracked files:\n" + "\n".join(bad)
+    # the two driver entry points must be in the tracked set at all
+    assert "bench.py" in tracked
+    assert "__graft_entry__.py" in tracked
+
+
+def test_bench_smoke_runs_green():
+    """Execute the real bench in --smoke mode (tiny shapes, CPU, <60 s
+    budget) and validate its one-line JSON contract."""
+    env = dict(os.environ)
+    # child must not inherit the suite's virtual 8-device mesh flags; smoke
+    # sets its own platform (cpu) internally
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [sys.executable, "bench.py", "--smoke"],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=180,
+        env=env,
+    )
+    assert proc.returncode == 0, (
+        f"bench --smoke rc={proc.returncode}\nstderr tail:\n"
+        + proc.stderr[-2000:]
+    )
+    lines = [l for l in proc.stdout.splitlines() if l.strip()]
+    assert lines, "bench --smoke printed nothing"
+    rec = json.loads(lines[-1])
+    assert rec["metric"].startswith("als_train_throughput")
+    assert rec["value"] > 0
+    detail = rec["detail"]
+    # every section must be present AND not an {"error": ...} fallback
+    for section in ("workflow", "twotower", "serving_latency"):
+        assert section in detail, f"missing bench section {section!r}"
+        assert "error" not in detail[section], (
+            f"bench section {section!r} errored: {detail[section]}"
+        )
+    serving = detail["serving_latency"]
+    for sub in ("host_path", "device_path", "event_ingest_http"):
+        assert sub in serving, f"missing serving sub-section {sub!r}"
+        assert "error" not in serving[sub], (
+            f"serving sub-section {sub!r} errored: {serving[sub]}"
+        )
+    assert serving["event_ingest_http"]["events_per_sec"] > 0
