@@ -70,6 +70,13 @@ def _make_handler(dispatch: Dispatcher):
         #: the lazy TLS handshake, which runs on first I/O in this
         #: worker thread; see _make_server)
         timeout = 60
+        #: keep-alive clients otherwise stall ~40 ms per request on the
+        #: Nagle/delayed-ACK interaction: headers and body would go out as
+        #: two segments, the second waiting on the client's delayed ACK
+        disable_nagle_algorithm = True
+        #: buffer the response so status+headers+body leave in one send
+        #: (handle_one_request flushes wfile after each request)
+        wbufsize = 64 * 1024
 
         def log_message(self, fmt, *args):  # route through logging, not stderr
             logger.debug("%s - %s", self.address_string(), fmt % args)

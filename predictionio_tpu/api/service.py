@@ -23,6 +23,9 @@ from __future__ import annotations
 import dataclasses
 import json
 import logging
+import os
+import threading
+import time
 from typing import Any, Mapping
 
 from predictionio_tpu.api.stats import Stats
@@ -68,6 +71,37 @@ class EventService:
     def __init__(self, stats: bool = False):
         self.stats_enabled = stats
         self.stats = Stats() if stats else None
+        # Resolved access keys, cached briefly: the ingest hot loop pays a
+        # metadata-store query per POST otherwise (SURVEY.md section 4.3 —
+        # the reference's spray routes resolve the key per request against
+        # HBase/JDBC, but those clients pool and cache; our sqlite metadata
+        # store shares the event-table lock, so per-POST lookups convoy).
+        # Staleness bound = PIO_ACCESSKEY_CACHE_SECS (0 disables); only
+        # positive lookups are cached so a just-created key works at once.
+        self._key_cache: dict[str, tuple[float, Any]] = {}
+        self._key_cache_lock = threading.Lock()
+        try:
+            self._key_cache_ttl = float(
+                os.environ.get("PIO_ACCESSKEY_CACHE_SECS", "2.0")
+            )
+        except ValueError:
+            self._key_cache_ttl = 2.0
+
+    def _resolve_key(self, key: str):
+        if self._key_cache_ttl <= 0:
+            return Storage.get_meta_data_access_keys().get(key)
+        now = time.monotonic()
+        with self._key_cache_lock:
+            hit = self._key_cache.get(key)
+            if hit is not None and now - hit[0] < self._key_cache_ttl:
+                return hit[1]
+        access_key = Storage.get_meta_data_access_keys().get(key)
+        if access_key is not None:
+            with self._key_cache_lock:
+                if len(self._key_cache) > 1024:  # unbounded-growth guard
+                    self._key_cache.clear()
+                self._key_cache[key] = (now, access_key)
+        return access_key
 
     # ---------------------------------------------------------------- auth
     def _auth(
@@ -91,7 +125,7 @@ class EventService:
                     key = None
         if not key:
             return _msg(401, "Missing accessKey.")
-        access_key = Storage.get_meta_data_access_keys().get(key)
+        access_key = self._resolve_key(key)
         if access_key is None:
             return _msg(401, "Invalid accessKey.")
         channel_name = params.get("channel")
@@ -128,7 +162,10 @@ class EventService:
         etype = body.get("entityType") if isinstance(body, Mapping) else None
         self.stats.update(app_id, status, name, etype)
 
-    def _insert_one(self, body: Any, access_key, channel_id) -> Response:
+    @staticmethod
+    def _validate_item(body: Any, access_key):
+        """Parse + authorize one event body -> Event, or an error Response
+        (shared by the single and batch routes so they can't diverge)."""
         if not isinstance(body, Mapping):
             return _msg(400, "Event must be a JSON object.")
         try:
@@ -137,6 +174,12 @@ class EventService:
             return _msg(400, str(e))
         if access_key.events and event.event not in access_key.events:
             return _msg(403, f"Event '{event.event}' is not allowed by this accessKey.")
+        return event
+
+    def _insert_one(self, body: Any, access_key, channel_id) -> Response:
+        event = self._validate_item(body, access_key)
+        if isinstance(event, Response):
+            return event
         event_id = Storage.get_l_events().insert(event, access_key.appid, channel_id)
         return Response(201, {"eventId": event_id})
 
@@ -154,13 +197,30 @@ class EventService:
             return _msg(400, "Batch events must be a JSON array.")
         if len(body) > MAX_BATCH_SIZE:
             return _msg(400, f"Batch size is greater than {MAX_BATCH_SIZE}.")
-        results = []
+        # Validate everything first, then write the valid events through ONE
+        # insert_batch call (single transaction on sqlite, one segment append
+        # on columnar) instead of a commit per item — the batch route exists
+        # to amortize exactly this (ref EventServer.scala batch route; the
+        # per-item status array contract is unchanged).
+        results: list[dict | None] = []
+        valid: list[tuple[int, Any]] = []  # (result slot, parsed Event)
         for item in body:
-            r = self._insert_one(item, access_key, channel_id)
-            entry = dict(r.body)
-            entry["status"] = r.status
-            results.append(entry)
-            self._record_stats(access_key.appid, item, r.status)
+            event = self._validate_item(item, access_key)
+            if isinstance(event, Response):
+                entry = dict(event.body)
+                entry["status"] = event.status
+                results.append(entry)
+                continue
+            valid.append((len(results), event))
+            results.append(None)  # filled after the bulk insert
+        if valid:
+            ids = Storage.get_l_events().insert_batch(
+                [e for _, e in valid], access_key.appid, channel_id
+            )
+            for (slot, _), eid in zip(valid, ids):
+                results[slot] = {"eventId": eid, "status": 201}
+        for item, entry in zip(body, results):
+            self._record_stats(access_key.appid, item, entry["status"])
         return Response(200, results)
 
     def get_event(

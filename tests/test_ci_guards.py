@@ -92,4 +92,6 @@ def test_bench_smoke_runs_green():
         assert "error" not in serving[sub], (
             f"serving sub-section {sub!r} errored: {serving[sub]}"
         )
-    assert serving["event_ingest_http"]["events_per_sec"] > 0
+    ingest = serving["event_ingest_http"]
+    assert ingest["single_post"]["events_per_sec"] > 0
+    assert ingest["batch_post"]["events_per_sec"] > 0
