@@ -69,6 +69,7 @@ __all__ = [
     "als_sweep",
     "predict_scores",
     "top_k_items",
+    "top_k_items_batch",
 ]
 
 #: Segment widths: multiples of 8 at ~1.33-1.5x steps, so within-bucket
@@ -1493,5 +1494,28 @@ def top_k_items(
     scores = item_factors @ user_vec
     if exclude_mask is not None:
         scores = jnp.where(exclude_mask, -jnp.inf, scores)
+    values, indices = jax.lax.top_k(scores, k)
+    return indices, values
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def top_k_items_batch(
+    user_idx: jax.Array,
+    user_factors: jax.Array,
+    item_factors: jax.Array,
+    k: int,
+) -> tuple[jax.Array, jax.Array]:
+    """Top-k for a BATCH of users in one dispatch: gather the user rows on
+    device, score every item with one ``[B, K] @ [K, I]`` GEMM (MXU work,
+    not B GEMVs), and ``lax.top_k`` each row. Returns ``([B, k] item ids,
+    [B, k] scores)`` — the only host transfer is the 2·B·k result.
+
+    This is the batch-amortized device serving path (ref
+    ``core/workflow/BatchPredict.scala`` ``batchPredictBase``): per-query
+    dispatch pays a full device round trip per prediction, which a
+    tunneled/remote accelerator turns into ~hundreds of ms; one dispatch
+    per chunk amortizes that latency over the whole chunk."""
+    user_vecs = user_factors[user_idx]
+    scores = user_vecs @ item_factors.T
     values, indices = jax.lax.top_k(scores, k)
     return indices, values

@@ -215,6 +215,13 @@ class QueryService:
             return 400, {"message": f"Invalid query: {e}"}
         query = serving.supplement_base(query)
         predictions = [algo.predict_base(model, query) for algo, model in pairs]
+        return self._finish_query(serving, body, query, predictions)
+
+    def _finish_query(
+        self, serving, body: Any, query: Any, predictions: Sequence[Any]
+    ) -> tuple[int, Any]:
+        """serve -> plugins -> feedback -> count, shared by the single and
+        batch routes so they cannot diverge."""
         result = serving.serve_base(query, predictions)
         payload = _result_to_json(result)
         pr_id = None
@@ -232,6 +239,70 @@ class QueryService:
         with self._lock:
             self.query_count += 1
         return 200, payload
+
+    def handle_batch(self, bodies: Sequence[Any]) -> list[tuple[int, Any]]:
+        """Batch-amortized :meth:`handle_query` (ref
+        ``core/workflow/BatchPredict.scala``): bind + supplement each query,
+        then push ALL of them through each algorithm's ``batch_predict_base``
+        — one chunked device dispatch instead of a round trip per query —
+        then the shared per-query tail (serve/plugins/feedback). Per-item
+        errors isolate: a malformed query gets its own 400, a query whose
+        predict/serve raises gets its own 500 (the bulk path falls back to
+        per-query prediction if the batched call itself raises); the batch
+        never aborts. Returns ``[(status, payload), ...]`` aligned with
+        input."""
+        with self._lock:
+            serving = self._serving
+            pairs = list(self._algo_model_pairs)
+        if serving is None:
+            return [(503, {"message": "No engine loaded"})] * len(bodies)
+        out: list[tuple[int, Any] | None] = [None] * len(bodies)
+        queries: list[tuple[int, Any]] = []
+        for i, body in enumerate(bodies):
+            if body is None:
+                out[i] = (400, {"message": "Query body is required (JSON)."})
+                continue
+            try:
+                query = self._bind_query(body, pairs)
+            except Exception as e:
+                out[i] = (400, {"message": f"Invalid query: {e}"})
+                continue
+            try:
+                query = serving.supplement_base(query)
+            except Exception as e:  # handle_query surfaces this as a 500 too
+                out[i] = (500, {"message": str(e)})
+                continue
+            queries.append((i, query))
+        by_slot: dict[int, list[Any]] = {i: [] for i, _ in queries}
+        if queries:
+            try:
+                for algo, model in pairs:
+                    for i, pred in algo.batch_predict_base(model, queries):
+                        by_slot[i].append(pred)
+            except Exception:
+                # one poisoned query must not fail the chunk: redo this
+                # chunk per query so only the offender gets a 500
+                logger.exception(
+                    "batch_predict failed; falling back to per-query predict"
+                )
+                by_slot = {}
+                for i, q in queries:
+                    try:
+                        by_slot[i] = [
+                            algo.predict_base(model, q) for algo, model in pairs
+                        ]
+                    except Exception as e:
+                        out[i] = (500, {"message": str(e)})
+        for i, query in queries:
+            if out[i] is not None:  # per-query fallback already failed it
+                continue
+            try:
+                out[i] = self._finish_query(serving, bodies[i], query, by_slot[i])
+            except Exception as e:
+                out[i] = (500, {"message": str(e)})
+        return [
+            o if o is not None else (500, {"message": "unprocessed"}) for o in out
+        ]
 
     # ------------------------------------------------------------ feedback
     def _send_feedback(self, query_body: Any, payload: Any, pr_id: str | None) -> None:

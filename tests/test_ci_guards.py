@@ -81,7 +81,7 @@ def test_bench_smoke_runs_green():
     assert rec["value"] > 0
     detail = rec["detail"]
     # every section must be present AND not an {"error": ...} fallback
-    for section in ("workflow", "twotower", "serving_latency"):
+    for section in ("workflow", "twotower", "serving_latency", "batchpredict"):
         assert section in detail, f"missing bench section {section!r}"
         assert "error" not in detail[section], (
             f"bench section {section!r} errored: {detail[section]}"
@@ -95,3 +95,7 @@ def test_bench_smoke_runs_green():
     ingest = serving["event_ingest_http"]
     assert ingest["single_post"]["events_per_sec"] > 0
     assert ingest["batch_post"]["events_per_sec"] > 0
+    bp = detail["batchpredict"]
+    for sub in ("host_path", "device_path"):
+        assert "error" not in bp[sub], f"batchpredict {sub} errored: {bp[sub]}"
+        assert bp[sub]["queries_per_sec"] > 0
