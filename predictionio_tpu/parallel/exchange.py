@@ -196,6 +196,12 @@ def pairwise_exchange(payloads: Sequence, timeout: float = 300.0) -> list[bytes]
     results: list = [None] * P
     results[me] = materialize(me)
     fatal: list = []  # post-authentication failures (peers never retry)
+    #: pre-auth connection failures. A GENUINE peer dying before token
+    #: auth lands here and the exchange then waits out the full timeout
+    #: (degraded failure latency — deliberate: failing fast on unproven
+    #: connections would let any stray/port-scan kill the exchange by
+    #: claiming a rank). The drop count is surfaced in the timeout error.
+    dropped_preauth: list = []
     done = threading.Event()  # all peers reported OR fatal
 
     def handle(conn: socket.socket, peer: Any) -> None:
@@ -233,8 +239,18 @@ def pairwise_exchange(payloads: Sequence, timeout: float = 300.0) -> list[bytes]
             else:
                 # a stray or untrusted connection must not burn the
                 # exchange: drop it and keep listening — completion is
-                # "every peer reported", not "P-1 accepts"
-                logger.warning("dropped p2p connection from %s: %s", peer, e)
+                # "every peer reported", not "P-1 accepts". If this WAS a
+                # real peer (reset mid-header/mid-auth), it never retries,
+                # so the exchange will now run out the full timeout —
+                # shout, so the operator sees the cause before the
+                # timeout error names the missing rank
+                dropped_preauth.append((peer, str(e)))
+                logger.error(
+                    "dropped unauthenticated p2p connection from %s: %s — "
+                    "if this was a real peer the exchange will time out "
+                    "in up to %.0fs",
+                    peer, e, timeout,
+                )
 
     def acceptor() -> None:
         import time
@@ -286,8 +302,14 @@ def pairwise_exchange(payloads: Sequence, timeout: float = 300.0) -> list[bytes]
         raise RuntimeError(f"pairwise exchange failed: {fatal[0]}") from fatal[0]
     missing = [p for p in range(P) if results[p] is None]
     if missing:
+        hint = (
+            f" ({len(dropped_preauth)} connection(s) were dropped before "
+            f"authenticating — one of them may have been the missing peer)"
+            if dropped_preauth
+            else ""
+        )
         raise RuntimeError(
-            f"pairwise exchange timed out waiting for processes {missing}"
+            f"pairwise exchange timed out waiting for processes {missing}{hint}"
         )
     return results
 

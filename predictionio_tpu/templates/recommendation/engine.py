@@ -385,8 +385,20 @@ class RecommendationDataSource(DataSource):
         new_segments = [
             s for s in state["segments"] if s not in cached_segments
         ]
-        with np.load(npz_path, allow_pickle=False) as z:
-            cache = {k: z[k] for k in z.files}
+        import zipfile
+
+        try:
+            with np.load(npz_path, allow_pickle=False) as z:
+                cache = {k: z[k] for k in z.files}
+        except (FileNotFoundError, ValueError, EOFError, OSError,
+                zipfile.BadZipFile):
+            # a truncated/empty payload (crash between replace and disk
+            # flush) invalidates the cache — fall back to a full rebuild
+            return None
+        # manifest and payload must be from the SAME save (advisor r4:
+        # concurrent trains can interleave the two atomic replaces)
+        if str(cache.pop("__payload_id__", "")) != meta.get("payload_id"):
+            return None
         p = self.params
         delta = pe.find_columns(
             app_id,
@@ -457,16 +469,29 @@ class RecommendationDataSource(DataSource):
 
     def _save_cache(self, payload: dict, state: dict) -> None:
         import json
+        import uuid
 
         npz_path, json_path = self._cache_paths()
         os.makedirs(os.path.dirname(npz_path), exist_ok=True)
+        # the same id is stored INSIDE both files: two concurrent trains
+        # interleaving their two atomic replaces could otherwise pair one
+        # run's manifest with the other's payload (advisor r4), and the
+        # manifest would then bless the wrong cached ratings as valid
+        payload_id = uuid.uuid4().hex
         tmp = npz_path + ".tmp"
         with open(tmp, "wb") as f:
-            np.savez(f, **payload)
+            np.savez(f, __payload_id__=np.array(payload_id), **payload)
         os.replace(tmp, npz_path)
         tmp = json_path + ".tmp"
         with open(tmp, "w") as f:
-            json.dump({"manifest": self._cache_manifest(), **state}, f)
+            json.dump(
+                {
+                    "manifest": self._cache_manifest(),
+                    "payload_id": payload_id,
+                    **state,
+                },
+                f,
+            )
         os.replace(tmp, json_path)
 
     def _read_training_columnar(self, ctx: WorkflowContext) -> TrainingData:

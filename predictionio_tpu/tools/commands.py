@@ -415,8 +415,16 @@ def undeploy(
         token = read_stop_token(port)
     scheme = "https" if https else "http"
     url = f"{scheme}://{ip}:{port}/stop"
+    # token travels in a header — query strings are routinely recorded by
+    # access logs and intermediary proxies (advisor r4). It is ALSO still
+    # sent as ?token= for one transition: servers deployed by an older
+    # version read only the query param, and undeploy must be able to
+    # stop them.
     if token:
         url += "?token=" + urllib.parse.quote(token, safe="")
+    req = urllib.request.Request(url)
+    if token:
+        req.add_header("X-PIO-Stop-Token", token)
     ctx = None
     if https:
         ctx = _ssl.create_default_context()
@@ -424,7 +432,7 @@ def undeploy(
             ctx.check_hostname = False
             ctx.verify_mode = _ssl.CERT_NONE
     try:
-        with urllib.request.urlopen(url, timeout=10, context=ctx) as resp:
+        with urllib.request.urlopen(req, timeout=10, context=ctx) as resp:
             resp.read()
     except urllib.error.HTTPError as e:
         # the server is UP but refused — report its actual answer, not a

@@ -377,9 +377,21 @@ class QueryService:
             # When stop_token is set (pio deploy always sets one), the
             # caller must present it — otherwise anyone who can reach the
             # port could shut down a production deployment (advisor r3).
-            if self.stop_token and not _token_ok(
-                params.get("token", ""), self.stop_token
-            ):
+            # Preferred carrier is the X-PIO-Stop-Token header (query
+            # strings leak into access logs / proxies — advisor r4); the
+            # query param stays accepted for older clients.
+            presented = ""
+            if headers:
+                presented = next(
+                    (
+                        v
+                        for k, v in headers.items()
+                        if k.lower() == "x-pio-stop-token"
+                    ),
+                    "",
+                )
+            presented = presented or params.get("token", "")
+            if self.stop_token and not _token_ok(presented, self.stop_token):
                 return Response(
                     403, {"message": "Missing or invalid stop token."}
                 )

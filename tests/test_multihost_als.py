@@ -111,7 +111,7 @@ print("MULTIHOST-ALS-OK", me)
 import pytest
 
 
-@pytest.mark.parametrize("nproc", [2, 4])
+@pytest.mark.parametrize("nproc", [2, 4, 8])
 def test_sharded_train_matches_single(tmp_path, nproc):
     rng = np.random.default_rng(0)
     num_users, num_items, nnz = 50, 30, 900
@@ -207,7 +207,7 @@ print("MULTIHOST-TEMPLATE-OK", me)
 """
 
 
-@pytest.mark.parametrize("nproc", [2, 4])
+@pytest.mark.parametrize("nproc", [2, 4, 8])
 def test_template_coherence(tmp_path, nproc):
     """ADVICE round-1 high: sharded datasource reads must yield identical
     global BiMaps and a coherent model. Each worker holds the full event
@@ -304,7 +304,7 @@ sys.exit(1)
 """
 
 
-@pytest.mark.parametrize("nproc", [2, 4])
+@pytest.mark.parametrize("nproc", [2, 4, 8])
 def test_dead_peer_fails_cleanly_not_hangs(tmp_path, nproc):
     """A peer that dies after rendezvous must surface as a prompt error
     on EVERY survivor, not a distributed-timeout hang — including at
