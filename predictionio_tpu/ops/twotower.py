@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+from types import MappingProxyType
 from typing import Any, NamedTuple
 
 import jax
@@ -54,8 +55,18 @@ class TwoTowerConfig:
     learning_rate: float = 0.05
     temperature: float = 0.1
     seed: int = 0
-    #: report the training loss every N steps (host readback)
+    #: keep a loss-history entry every N steps (losses are computed every
+    #: step on device and read back once per epoch)
     log_every: int = 50
+    #: matmul input dtype for the in-batch logits ("bfloat16" rides the
+    #: MXU at full rate with fp32 accumulation — the TPU-native default;
+    #: "float32" for bit-for-bit comparisons)
+    gemm_dtype: str = "bfloat16"
+    #: the flash-style fused softmax-CE kernel (ops/fused_ce.py): "auto"
+    #: uses it on single-device TPU runs with supported shapes, "off"
+    #: forces the XLA path, "interpret" runs the kernel in interpreter
+    #: mode (CPU tests). The [B, B] logits never touch HBM with it on.
+    fused_ce: str = "auto"
 
 
 class TwoTowerModel(NamedTuple):
@@ -65,6 +76,12 @@ class TwoTowerModel(NamedTuple):
     user_vecs: Any  # [U, D]
     item_vecs: Any  # [I, D]
     loss_history: tuple  # ((step, loss), ...)
+    #: phase wall-clock: ingest (interaction upload), train (epoch loop),
+    #: finalize (replicate + host readback). On a tunneled chip the
+    #: ingest/finalize transfers dominate at small model sizes — benches
+    #: must not book them against the training loop. Immutable default:
+    #: a shared mutable {} would alias across default-built instances.
+    timings: Any = MappingProxyType({})
 
 
 def sharded_embedding_lookup(
@@ -105,6 +122,132 @@ def sharded_embedding_lookup(
         in_specs=(PartitionSpec(model_axis, None), PartitionSpec(data_axis)),
         out_specs=PartitionSpec(data_axis, None),
     )(table, ids)
+
+
+@functools.lru_cache(maxsize=16)
+def _epoch_program(
+    mesh: Mesh | None,
+    data_axis: str | None,
+    model_axis: str | None,
+    B: int,
+    n_pad: int,
+    steps_per_epoch: int,
+    learning_rate: float,
+    inv_temp: float,
+    gemm_dtype_name: str,
+    fused_ce_mode: str,
+):
+    """Build (and cache) the jitted per-epoch training program.
+
+    The program is keyed on everything that shapes its trace, so repeat
+    trains in one process — warm retrains, evaluation sweeps, the bench's
+    warm-up/timed pair — reuse the SAME jit object instead of re-tracing
+    a fresh closure each call (re-tracing the full-epoch scan costs ~1 s
+    even with the persistent compile cache hitting)."""
+    import jax
+
+    gemm_dtype = jnp.bfloat16 if gemm_dtype_name == "bfloat16" else jnp.float32
+    from predictionio_tpu.ops.fused_ce import (
+        fused_ce_supported,
+        fused_inbatch_ce,
+    )
+
+    on_tpu = jax.devices()[0].platform not in ("cpu", "gpu")
+    use_fused_base = (
+        mesh is None  # in-batch negatives are global; mesh path stays XLA
+        and gemm_dtype == jnp.bfloat16  # the kernel's GEMMs are bf16
+        and (
+            fused_ce_mode == "interpret"
+            or (fused_ce_mode == "auto" and on_tpu)
+        )
+    )
+    fused_interpret = fused_ce_mode == "interpret"
+    rep_sharding = (
+        None if mesh is None else NamedSharding(mesh, PartitionSpec())
+    )
+    tx = optax.adam(learning_rate)
+
+    def _logits(a, b):
+        # bf16 operands ride the MXU at full rate; accumulation stays
+        # fp32 (preferred_element_type), so the softmax sees fp32 logits
+        return (
+            jnp.matmul(
+                a.astype(gemm_dtype),
+                b.astype(gemm_dtype).T,
+                preferred_element_type=jnp.float32,
+            )
+            * inv_temp
+        )
+
+    def loss_fn(p, u_ids, i_ids):
+        ue = sharded_embedding_lookup(p["user"], u_ids, mesh, data_axis, model_axis)
+        ie = sharded_embedding_lookup(p["item"], i_ids, mesh, data_axis, model_axis)
+        ue = ue / (jnp.linalg.norm(ue, axis=-1, keepdims=True) + 1e-8)
+        ie = ie / (jnp.linalg.norm(ie, axis=-1, keepdims=True) + 1e-8)
+        if use_fused_base and fused_ce_supported(B, ue.shape[-1], inv_temp):
+            return fused_inbatch_ce(ue, ie, inv_temp, fused_interpret)
+        labels = jnp.arange(B)
+        if mesh is not None:
+            # in-batch logits need every negative on every device: keep
+            # the LEFT side batch-sharded and replicate the right side (a
+            # tiny [B, D] all-gather) — [B@data, B@data] is not a legal
+            # layout, and labels must shard like the logits rows
+            rep = NamedSharding(mesh, PartitionSpec(None, None))
+            ue_r = jax.sharding.reshard(ue, rep)
+            ie_r = jax.sharding.reshard(ie, rep)
+            labels = jax.sharding.reshard(
+                labels, NamedSharding(mesh, PartitionSpec(data_axis))
+            )
+        else:
+            ue_r, ie_r = ue, ie
+        # symmetric in-batch softmax: user->item and item->user
+        l1 = optax.softmax_cross_entropy_with_integer_labels(
+            _logits(ue, ie_r), labels
+        )
+        l2 = optax.softmax_cross_entropy_with_integer_labels(
+            _logits(ie, ue_r), labels
+        )
+        return 0.5 * (l1.mean() + l2.mean())
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def train_epoch(p, o, epoch, r, c, perm_key):
+        """ONE device program per epoch: permutation gather + a lax.scan
+        over every step. A step-per-dispatch loop pays the host->device
+        round trip per step — through a tunneled/remote accelerator that
+        overhead alone caps throughput regardless of batch size. Returns
+        per-step losses (read back once per epoch).
+
+        Fresh permutation per epoch: in-batch softmax draws its negatives
+        from the batch, so replaying one fixed batching would freeze
+        every positive's negative set for the whole run."""
+        perm = jax.random.permutation(jax.random.fold_in(perm_key, epoch), n_pad)
+        r_all, c_all = r[perm], c[perm]
+        if rep_sharding is not None:
+            r_all = jax.sharding.reshard(r_all, rep_sharding)
+            c_all = jax.sharding.reshard(c_all, rep_sharding)
+
+        def body(carry, step):
+            p, o = carry
+            off = step * B
+            u_ids = jax.lax.dynamic_slice(r_all, (off,), (B,))
+            i_ids = jax.lax.dynamic_slice(c_all, (off,), (B,))
+            if mesh is not None:
+                # reshard, not with_sharding_constraint: make_mesh axes
+                # are Explicit in current jax, and the batch must be
+                # data-sharded before entering the shard_map lookups
+                bspec = NamedSharding(mesh, PartitionSpec(data_axis))
+                u_ids = jax.sharding.reshard(u_ids, bspec)
+                i_ids = jax.sharding.reshard(i_ids, bspec)
+            loss, grads = jax.value_and_grad(loss_fn)(p, u_ids, i_ids)
+            updates, o = tx.update(grads, o, p)
+            return (optax.apply_updates(p, updates), o), loss
+
+        (p, o), losses = jax.lax.scan(
+            body, (p, o), jnp.arange(steps_per_epoch)
+        )
+        return p, o, losses
+
+    return train_epoch, tx
 
 
 def _pad_rows(n: int, mult: int) -> int:
@@ -178,94 +321,45 @@ def train_two_tower(
     # device-side permutation gather (the previous per-epoch host
     # permutation + re-upload was a full-dataset transfer stall per epoch
     # — VERDICT r3 weak #6)
+    import time as _time
+
+    t_ingest = _time.perf_counter()
     r_base = jnp.asarray(rows[reps].astype(np.int32))
     c_base = jnp.asarray(cols[reps].astype(np.int32))
     if rep_sharding is not None:
         r_base = jax.device_put(r_base, rep_sharding)
         c_base = jax.device_put(c_base, rep_sharding)
+    int(c_base[-1])  # hard sync: the upload is complete, not just enqueued
+    t_ingest = _time.perf_counter() - t_ingest
 
-    permute_kw = (
-        {"out_shardings": rep_sharding} if rep_sharding is not None else {}
-    )
-
-    @functools.partial(jax.jit, **permute_kw)
-    def epoch_perm(epoch, r, c):
-        """Fresh permutation per epoch: in-batch softmax draws its
-        negatives from the batch, so replaying one fixed batching would
-        freeze every positive's negative set for the whole run."""
-        perm = jax.random.permutation(jax.random.fold_in(k_perm, epoch), n_pad)
-        return r[perm], c[perm]
-
-    def epoch_arrays(epoch: int):
-        return epoch_perm(jnp.int32(epoch), r_base, c_base)
-
-    tx = optax.adam(config.learning_rate)
-    opt_state = tx.init(params)
     steps_per_epoch = n_pad // B
     inv_temp = 1.0 / config.temperature
-
-    def loss_fn(p, u_ids, i_ids):
-        ue = sharded_embedding_lookup(p["user"], u_ids, mesh, data_axis, model_axis)
-        ie = sharded_embedding_lookup(p["item"], i_ids, mesh, data_axis, model_axis)
-        ue = ue / (jnp.linalg.norm(ue, axis=-1, keepdims=True) + 1e-8)
-        ie = ie / (jnp.linalg.norm(ie, axis=-1, keepdims=True) + 1e-8)
-        labels = jnp.arange(B)
-        if mesh is not None:
-            # in-batch logits need every negative on every device: keep
-            # the LEFT side batch-sharded and replicate the right side (a
-            # tiny [B, D] all-gather) — [B@data, B@data] is not a legal
-            # layout, and labels must shard like the logits rows
-            rep = NamedSharding(mesh, PartitionSpec(None, None))
-            ue_r = jax.sharding.reshard(ue, rep)
-            ie_r = jax.sharding.reshard(ie, rep)
-            labels = jax.sharding.reshard(
-                labels, NamedSharding(mesh, PartitionSpec(data_axis))
-            )
-        else:
-            ue_r, ie_r = ue, ie
-        # symmetric in-batch softmax: user->item and item->user
-        l1 = optax.softmax_cross_entropy_with_integer_labels(
-            (ue @ ie_r.T) * inv_temp, labels
-        )
-        l2 = optax.softmax_cross_entropy_with_integer_labels(
-            (ie @ ue_r.T) * inv_temp, labels
-        )
-        return 0.5 * (l1.mean() + l2.mean())
-
-    @functools.partial(jax.jit, donate_argnums=(0, 1))
-    def train_step(p, o, step, r_all, c_all):
-        off = (step % steps_per_epoch) * B
-        u_ids = jax.lax.dynamic_slice(r_all, (off,), (B,))
-        i_ids = jax.lax.dynamic_slice(c_all, (off,), (B,))
-        if mesh is not None:
-            # reshard, not with_sharding_constraint: make_mesh axes are
-            # Explicit in current jax, and the batch must be data-sharded
-            # before entering the shard_map lookups
-            bspec = NamedSharding(mesh, PartitionSpec(data_axis))
-            u_ids = jax.sharding.reshard(u_ids, bspec)
-            i_ids = jax.sharding.reshard(i_ids, bspec)
-        loss, grads = jax.value_and_grad(loss_fn)(p, u_ids, i_ids)
-        updates, o = tx.update(grads, o, p)
-        return optax.apply_updates(p, updates), o, loss
+    train_epoch, tx = _epoch_program(
+        mesh, data_axis, model_axis, B, n_pad, steps_per_epoch,
+        config.learning_rate, inv_temp, config.gemm_dtype, config.fused_ce,
+    )
+    opt_state = tx.init(params)
 
     history = []
     total_steps = config.epochs * steps_per_epoch
-    step = 0
+    t_train = _time.perf_counter()
     for epoch in range(config.epochs):
-        r_all, c_all = epoch_arrays(epoch)
-        for _ in range(steps_per_epoch):
-            params, opt_state, loss = train_step(
-                params, opt_state, step, r_all, c_all
-            )
+        params, opt_state, losses = train_epoch(
+            params, opt_state, jnp.int32(epoch), r_base, c_base, k_perm
+        )
+        losses_np = np.asarray(losses)  # one readback per epoch
+        for i, loss in enumerate(losses_np):
+            step = epoch * steps_per_epoch + i
             if step % config.log_every == 0 or step == total_steps - 1:
                 history.append((step, float(loss)))
-            step += 1
+    t_train = _time.perf_counter() - t_train
 
     def _finalize(p):
         u = p["user"] / (jnp.linalg.norm(p["user"], axis=-1, keepdims=True) + 1e-8)
         v = p["item"] / (jnp.linalg.norm(p["item"], axis=-1, keepdims=True) + 1e-8)
         return u, v
 
+    t_final = _time.perf_counter()
     if mesh is not None:
         # replicate before the host reads the (possibly model-sharded)
         # tables; slicing off the padding rows happens host-side
@@ -274,8 +368,16 @@ def train_two_tower(
         )(params)
     else:
         u, v = jax.jit(_finalize)(params)
+    user_vecs = np.asarray(u)[:num_users]
+    item_vecs = np.asarray(v)[:num_items]
+    t_final = _time.perf_counter() - t_final
     return TwoTowerModel(
-        user_vecs=np.asarray(u)[:num_users],
-        item_vecs=np.asarray(v)[:num_items],
+        user_vecs=user_vecs,
+        item_vecs=item_vecs,
         loss_history=tuple(history),
+        timings={
+            "ingest_seconds": round(t_ingest, 4),
+            "train_seconds": round(t_train, 4),
+            "finalize_seconds": round(t_final, 4),
+        },
     )

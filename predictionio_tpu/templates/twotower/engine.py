@@ -250,12 +250,19 @@ class TwoTowerParams(Params):
     #: ALS template
     serve_on_device: bool = False
     device_latency_budget_ms: float = 10.0
+    #: "bfloat16" (TPU-native default) or "float32" for bit-for-bit runs
+    gemm_dtype: str = "bfloat16"
+    #: fused softmax-CE kernel: "auto" | "off" | "interpret" (see
+    #: ops/fused_ce.py) — the opt-out if the Pallas path misbehaves
+    fused_ce: str = "auto"
     json_aliases = {
         "embeddingDim": "embedding_dim",
         "batchSize": "batch_size",
         "learningRate": "learning_rate",
         "serveOnDevice": "serve_on_device",
         "deviceLatencyBudgetMs": "device_latency_budget_ms",
+        "gemmDtype": "gemm_dtype",
+        "fusedCe": "fused_ce",
     }
 
 
@@ -290,6 +297,8 @@ class TwoTowerAlgorithm(JaxAlgorithm):
                 learning_rate=p.learning_rate,
                 temperature=p.temperature,
                 seed=p.seed,
+                gemm_dtype=p.gemm_dtype,
+                fused_ce=p.fused_ce,
             ),
             mesh=ctx.mesh,
         )
