@@ -20,6 +20,7 @@ JSON key -> field name. Aliases apply in both directions.
 from __future__ import annotations
 
 import dataclasses
+import functools
 import inspect
 from typing import Any, Mapping, Type, TypeVar
 
@@ -85,6 +86,26 @@ def params_to_json(params: Any) -> dict[str, Any]:
     return out
 
 
+_HINTS_CACHE: dict[type, Mapping[str, Any]] = {}
+
+
+def _type_hints_cached(cls: type) -> Mapping[str, Any]:
+    hints = _HINTS_CACHE.get(cls)
+    if hints is None:
+        try:
+            import typing
+
+            hints = typing.get_type_hints(cls)
+        except Exception:
+            # transient failure (e.g. mid-circular-import forward ref):
+            # fall back WITHOUT caching, so a later call can succeed
+            return {}
+        if len(_HINTS_CACHE) > 512:  # unbounded-growth guard
+            _HINTS_CACHE.clear()
+        _HINTS_CACHE[cls] = hints
+    return hints
+
+
 def params_from_json(cls: Type[P], obj: Mapping[str, Any] | None) -> P:
     """Bind a JSON object to a Params class, strictly.
 
@@ -114,13 +135,11 @@ def params_from_json(cls: Type[P], obj: Mapping[str, Any] | None) -> P:
         fields = {f.name: f for f in dataclasses.fields(cls) if f.init}
         names = set(fields)
         # Reconstruct nested dataclass fields (params_to_json deep-converts
-        # via asdict, so the round-trip must deep-bind too).
-        try:
-            import typing
-
-            hints = typing.get_type_hints(cls)
-        except Exception:
-            hints = {}
+        # via asdict, so the round-trip must deep-bind too). Hints are
+        # cached per class: get_type_hints re-evaluates annotations and
+        # was 40% of the whole batchpredict product path when run per
+        # bound query.
+        hints = _type_hints_cached(cls)
         for key, value in list(obj.items()):
             hint = hints.get(key)
             if (

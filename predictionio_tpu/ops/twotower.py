@@ -152,7 +152,10 @@ def _epoch_program(
         fused_inbatch_ce,
     )
 
-    on_tpu = jax.devices()[0].platform not in ("cpu", "gpu")
+    # strict platform check: the axon tunnel backend also reports "tpu";
+    # anything else (gpu, metal, ...) must take the XLA fallback rather
+    # than attempt a Mosaic lowering
+    on_tpu = jax.devices()[0].platform == "tpu"
     use_fused_base = (
         mesh is None  # in-batch negatives are global; mesh path stays XLA
         and gemm_dtype == jnp.bfloat16  # the kernel's GEMMs are bf16

@@ -37,21 +37,47 @@ def run_batch_predict(
             nonlocal n
             if not batch:
                 return
-            # the batch path: ONE chunked device dispatch per algorithm
-            # (ref BatchPredict.scala batchPredictBase) instead of a
-            # supplement/predict/serve round trip per line
-            for query, (status, payload) in zip(
-                batch, service.handle_batch(batch)
-            ):
-                fout.write(
-                    json.dumps(
-                        {"query": query, "prediction": payload}
-                        if status == 200
-                        else {"query": query, "error": payload, "status": status},
-                        default=str,
+            # fast path first: payload strings straight from the
+            # vectorized scorer (None = unavailable for this engine; a
+            # None ENTRY = that body needs the exact slow path)
+            fast = service.handle_batch_jsonlines(batch)
+            slow_idx = (
+                [i for i, line in enumerate(fast) if line is None]
+                if fast is not None
+                else list(range(len(batch)))
+            )
+            slow = {}
+            if slow_idx:
+                # ONE chunked device dispatch per algorithm (ref
+                # BatchPredict.scala batchPredictBase) instead of a
+                # supplement/predict/serve round trip per line
+                slow = dict(zip(
+                    slow_idx,
+                    service.handle_batch([batch[i] for i in slow_idx]),
+                ))
+            for i, query in enumerate(batch):
+                if fast is not None and fast[i] is not None:
+                    # the input line IS the query JSON; compose without
+                    # re-serializing either side
+                    fout.write(
+                        '{"query": %s, "prediction": %s}\n'
+                        % (json.dumps(query), fast[i])
                     )
-                    + "\n"
-                )
+                else:
+                    status, payload = slow[i]
+                    fout.write(
+                        json.dumps(
+                            {"query": query, "prediction": payload}
+                            if status == 200
+                            else {
+                                "query": query,
+                                "error": payload,
+                                "status": status,
+                            },
+                            default=str,
+                        )
+                        + "\n"
+                    )
                 n += 1
             batch.clear()
 

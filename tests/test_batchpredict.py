@@ -182,6 +182,52 @@ def test_handle_batch_isolates_poisoned_query(trained_app, monkeypatch):
     assert len(res[0][1]["itemScores"]) == 2
 
 
+def test_fast_jsonlines_path_matches_slow_path(trained_app, tmp_path):
+    """The vectorized jsonlines fast path must produce the same file as
+    the dataclass slow path — across valid queries, unknown users, and
+    bodies the fast path refuses (extra keys, wrong types -> slow 400)."""
+    from predictionio_tpu.tools.batchpredict import run_batch_predict
+    from predictionio_tpu.workflow.serving import QueryService
+
+    ej = tmp_path / "engine.json"
+    ej.write_text(json.dumps(VARIANT))
+    inp = tmp_path / "queries.jsonl"
+    inp.write_text(
+        "\n".join([
+            json.dumps({"user": "0", "num": 3}),
+            json.dumps({"user": "ghost", "num": 3}),
+            json.dumps({"user": "1"}),                      # default num
+            json.dumps({"user": "2", "num": 3, "x": 1}),    # extra key -> 400
+            json.dumps({"user": "3", "num": 2.5}),          # float num
+            json.dumps({"user": "4", "num": 0}),            # k == 0
+        ]) + "\n"
+    )
+    out_fast = tmp_path / "fast.jsonl"
+    n1 = run_batch_predict(str(ej), str(inp), str(out_fast))
+    out_slow = tmp_path / "slow.jsonl"
+    orig = QueryService.handle_batch_jsonlines
+    try:
+        QueryService.handle_batch_jsonlines = lambda self, bodies: None
+        n2 = run_batch_predict(str(ej), str(inp), str(out_slow))
+    finally:
+        QueryService.handle_batch_jsonlines = orig
+    assert n1 == n2 == 6
+    fast = [json.loads(l) for l in out_fast.read_text().splitlines()]
+    slow = [json.loads(l) for l in out_slow.read_text().splitlines()]
+    for f, s in zip(fast, slow):
+        assert f.keys() == s.keys(), (f, s)
+        assert f["query"] == s["query"]
+        if "prediction" in f:
+            fi = f["prediction"]["itemScores"]
+            si = s["prediction"]["itemScores"]
+            assert [x["item"] for x in fi] == [x["item"] for x in si]
+            np.testing.assert_allclose(
+                [x["score"] for x in fi], [x["score"] for x in si], rtol=1e-6
+            )
+        else:
+            assert f["status"] == s["status"] == 400
+
+
 def test_run_batch_predict_file_round_trip(trained_app, tmp_path):
     from predictionio_tpu.tools.batchpredict import run_batch_predict
 
