@@ -11,11 +11,12 @@ from predictionio_tpu.e2.engine import (
     CategoricalNaiveBayes,
     MarkovChain,
 )
-from predictionio_tpu.e2.evaluation import k_fold_split
+from predictionio_tpu.e2.evaluation import k_fold_split, stratified_k_fold_split
 
 __all__ = [
     "BinaryVectorizer",
     "CategoricalNaiveBayes",
     "MarkovChain",
     "k_fold_split",
+    "stratified_k_fold_split",
 ]

@@ -77,6 +77,35 @@ class TestKFold:
         with pytest.raises(ValueError):
             k_fold_split(data, 1)
 
+    def test_stratified_balances_rare_class(self):
+        from predictionio_tpu.e2 import stratified_k_fold_split
+
+        # rare class "b" sits at indices 0, 3, 6 — all congruent mod 3, so
+        # a plain index round-robin (k_fold_split) would dump ALL of "b"
+        # into fold 0's test split and starve folds 1 and 2 of the class;
+        # only per-label round-robin spreads them one per fold
+        data = []
+        for i in range(30):
+            data.append(("b" if i in (0, 3, 6) else "a", i))
+        from predictionio_tpu.e2 import k_fold_split as plain
+
+        plain_b = [
+            sum(1 for x in test if x[0] == "b")
+            for _, test in plain(data, 3)
+        ]
+        assert plain_b == [3, 0, 0], "test data no longer adversarial"
+        folds = stratified_k_fold_split(data, 3, label=lambda x: x[0])
+        assert len(folds) == 3
+        for train, test in folds:
+            assert sorted(train + test) == sorted(data)
+            # every fold's test split holds exactly one rare-class element
+            assert sum(1 for x in test if x[0] == "b") == 1
+            assert sum(1 for x in test if x[0] == "a") == 9
+        all_test = [x for _, test in folds for x in test]
+        assert sorted(all_test) == sorted(data)
+        with pytest.raises(ValueError):
+            stratified_k_fold_split(data, 1, label=lambda x: x[0])
+
 
 class TestSelfCleaning:
     def test_compaction_and_ttl(self, memory_storage_env):
