@@ -35,12 +35,8 @@ from predictionio_tpu.controller import (
 )
 from predictionio_tpu.data.aggregator import BiMap
 from predictionio_tpu.data.store import PEventStore
-from predictionio_tpu.ops.als import (
-    ALSConfig,
-    top_k_items,
-    top_k_items_batch,
-    train_als,
-)
+from predictionio_tpu.templates.serving_util import TOPK_CHUNK
+from predictionio_tpu.ops.als import ALSConfig, top_k_items, train_als
 
 __all__ = [
     "Query",
@@ -767,7 +763,8 @@ class ALSAlgorithm(JaxAlgorithm):
             # sizes below ~10^6 items
             scores = model.item_factors @ np.asarray(model.user_factors[uidx])
             part = np.argpartition(scores, -k)[-k:]
-            top = part[np.argsort(scores[part])[::-1]]
+            # ties break by ascending item index (the lax.top_k rule)
+            top = part[np.lexsort((part, -scores[part]))]
             pairs = [(int(i), float(scores[i])) for i in top]
         else:
             idx, scores = top_k_items(model.user_factors[uidx], model.item_factors, k)
@@ -778,9 +775,10 @@ class ALSAlgorithm(JaxAlgorithm):
             )
         )
 
-    #: queries per device dispatch / host GEMM — one compiled shape, so
-    #: every chunk (the last one padded up) reuses the same XLA program
-    BATCH_PREDICT_CHUNK = 2048
+    #: queries per device dispatch / host GEMM (shared tuning constant —
+    #: see serving_util.TOPK_CHUNK; kept as a class attribute so tests
+    #: can shrink it to force multi-chunk coverage)
+    BATCH_PREDICT_CHUNK = TOPK_CHUNK
 
     def batch_predict(
         self, model: ALSModel, queries: Sequence[tuple[int, Query]]
@@ -816,76 +814,14 @@ class ALSAlgorithm(JaxAlgorithm):
         return results
 
     def _topk_staged(self, model: ALSModel, valid: list):
-        """Chunked top-k over ``valid = [(slot, uidx, k), ...]``; yields
-        ``(part, ids, scores)`` with ids/scores as Python lists.
+        """Chunked top-k over ``valid = [(slot, uidx, k), ...]`` — see
+        :func:`predictionio_tpu.templates.serving_util.chunked_topk`."""
+        from predictionio_tpu.templates.serving_util import chunked_topk
 
-        k buckets to the next power of two (floor 16): the jitted
-        kernel's k is static, so raw max(num) would recompile per
-        distinct value — a bounded bucket set keeps one XLA program per
-        bucket and each query trims its own k from the padded result.
-        tolist() converts whole chunks to Python ints/floats at C speed —
-        per-element float(np_scalar) in row loops was a measured hot
-        spot."""
-        n_items = len(model.item_index)
-        k_max = max(k for _, _, k in valid)
-        k_max = min(n_items, max(16, 1 << (k_max - 1).bit_length()))
-        on_device = not isinstance(model.item_factors, np.ndarray)
-        chunk = self.BATCH_PREDICT_CHUNK
-        staged: list[tuple[list, Any, Any]] = []
-        for lo in range(0, len(valid), chunk):
-            part = valid[lo : lo + chunk]
-            uidx_arr = np.fromiter((u for _, u, _ in part), np.int32, len(part))
-            if on_device:
-                # pad to the fixed chunk shape: every chunk hits the same
-                # compiled program (row 0 is a harmless duplicate gather).
-                # Dispatches stay ASYNC here — materializing inside the
-                # loop would serialize one device round trip per chunk;
-                # enqueueing them all first overlaps the transfers
-                padded = np.zeros(chunk, np.int32)
-                padded[: len(part)] = uidx_arr
-                idx_b, score_b = top_k_items_batch(
-                    padded, model.user_factors, model.item_factors, k_max
-                )
-            else:
-                scores = (
-                    np.asarray(model.user_factors)[uidx_arr]
-                    @ np.asarray(model.item_factors).T
-                )  # [B, I]
-                rows = np.arange(len(part))[:, None]
-                sel = np.argpartition(scores, -k_max, axis=1)[:, -k_max:]
-                vals = scores[rows, sel]
-                order = np.argsort(-vals, axis=1)
-                idx_b = sel[rows, order]
-                score_b = vals[rows, order]
-            staged.append((part, idx_b, score_b))
-        if on_device and len(staged) > 1:
-            # ONE device->host transfer for the whole request set: per-
-            # chunk np.asarray paid a full link round trip per chunk
-            # (measured ~88 ms each through the tunnel — it, not compute,
-            # was the batchpredict device path's wall)
-            import jax.numpy as jnp
-
-            idx_all = np.asarray(
-                jnp.concatenate([i for _, i, _ in staged], axis=0)
-            )
-            score_all = np.asarray(
-                jnp.concatenate([s for _, _, s in staged], axis=0)
-            )
-            off = 0
-            for part, _, _ in staged:
-                yield (
-                    part,
-                    idx_all[off : off + len(part)].tolist(),
-                    score_all[off : off + len(part)].tolist(),
-                )
-                off += chunk
-            return
-        for part, idx_b, score_b in staged:
-            yield (
-                part,
-                np.asarray(idx_b)[: len(part)].tolist(),
-                np.asarray(score_b)[: len(part)].tolist(),
-            )
+        return chunked_topk(
+            model.user_factors, model.item_factors, valid,
+            chunk=self.BATCH_PREDICT_CHUNK,
+        )
 
     def batch_predict_json(
         self, model: ALSModel, bodies: Sequence[Any]

@@ -336,6 +336,53 @@ class TwoTowerAlgorithm(JaxAlgorithm):
             self.predict(model, Query(user=model.user_index.keys()[0], num=4))
         return model
 
+    def batch_predict(
+        self, model: TwoTowerServingModel, queries
+    ) -> list[tuple[int, PredictedResult]]:
+        """Batch-amortized retrieval (same chunked-GEMM core as the ALS
+        template — `pio batchpredict` and eval sweeps go through here
+        instead of one GEMV/dispatch per query). Seen-item filtering
+        matches :meth:`predict`: fetch ``num + len(seen)`` candidates,
+        then drop seen ones host-side."""
+        from predictionio_tpu.templates.serving_util import chunked_topk
+
+        n_items = len(model.item_index)
+        results: list[tuple[int, PredictedResult]] = []
+        valid: list[tuple[int, int, int]] = []
+        seen_by_slot: dict[int, tuple] = {}
+        nums: dict[int, int] = {}
+        for idx, q in queries:
+            uidx = model.user_index.get(q.user)
+            num = int(q.num)
+            if uidx is None or num <= 0:
+                results.append((idx, PredictedResult(())))
+                continue
+            seen = model.seen.get(q.user, ())
+            k = min(num + len(seen), n_items)
+            if k <= 0:
+                results.append((idx, PredictedResult(())))
+                continue
+            seen_by_slot[idx] = seen
+            nums[idx] = num
+            valid.append((idx, uidx, k))
+        inverse = model.item_index.inverse
+        for part, idx_l, score_l in chunked_topk(
+            model.user_vecs, model.item_vecs, valid
+        ):
+            for (oi, _, k), ids, scs in zip(part, idx_l, score_l):
+                seen = seen_by_slot[oi]
+                num = nums[oi]
+                out = []
+                for i, s in zip(ids[:k], scs[:k]):
+                    item = inverse(i)
+                    if item in seen:
+                        continue
+                    out.append(ItemScore(item=item, score=s))
+                    if len(out) >= num:
+                        break
+                results.append((oi, PredictedResult(tuple(out))))
+        return results
+
     def predict(self, model: TwoTowerServingModel, query: Query) -> PredictedResult:
         uidx = model.user_index.get(query.user)
         if uidx is None or int(query.num) <= 0:
@@ -347,7 +394,8 @@ class TwoTowerAlgorithm(JaxAlgorithm):
         if isinstance(model.item_vecs, np.ndarray):
             scores = model.item_vecs @ np.asarray(model.user_vecs[uidx])
             part = np.argpartition(scores, -k)[-k:]
-            top = part[np.argsort(scores[part])[::-1]]
+            # ties break by ascending item index (the lax.top_k rule)
+            top = part[np.lexsort((part, -scores[part]))]
             pairs = [(int(i), float(scores[i])) for i in top]
         else:
             from predictionio_tpu.ops.als import top_k_items

@@ -218,6 +218,25 @@ def build_parser() -> argparse.ArgumentParser:
     sub.add_parser(
         "build", help="no-op (Python engines need no compilation; kept for parity)"
     )
+
+    # ---- run: execute a command with the storage/config env injected
+    # (parity: Console.scala `pio run <main class>` launching user code
+    # against the configured storage; here the subprocess inherits the
+    # resolved PIO_* env so ad-hoc scripts see the same storage the CLI
+    # does)
+    run_p = sub.add_parser(
+        "run", help="run a command with the framework environment injected"
+    )
+    run_p.add_argument(
+        "run_args", nargs=argparse.REMAINDER,
+        help="command and arguments (e.g. `pio run python myscript.py`)",
+    )
+
+    # ---- upgrade (informational parity stub)
+    sub.add_parser(
+        "upgrade",
+        help="print upgrade guidance (pip-managed; no in-place upgrader)",
+    )
     return p
 
 
@@ -485,6 +504,34 @@ def main(argv: list[str] | None = None) -> int:
             print(
                 "Nothing to build: Python engines are imported directly. "
                 "(kept for command-line parity with the reference)"
+            )
+        elif cmd == "run":
+            import subprocess
+
+            cmdline = list(args.run_args)
+            if cmdline and cmdline[0] == "--":
+                cmdline = cmdline[1:]
+            if not cmdline:
+                print("ERROR: pio run needs a command to execute",
+                      file=sys.stderr)
+                return 1
+            env = dict(os.environ)
+            from predictionio_tpu.data.storage import Storage
+
+            env.setdefault("PIO_FS_BASEDIR", Storage.base_dir())
+            repo_root = os.path.dirname(
+                os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+            )
+            env["PYTHONPATH"] = (
+                repo_root + os.pathsep + env.get("PYTHONPATH", "")
+            ).rstrip(os.pathsep)
+            return subprocess.run(cmdline, env=env).returncode
+        elif cmd == "upgrade":
+            print(
+                "predictionio_tpu is a Python package: upgrade with your "
+                "package manager (e.g. `pip install -U predictionio_tpu`). "
+                "Storage formats are forward-compatible within a major "
+                "version; no in-place upgrader is needed."
             )
         return 0
     except Exception as e:

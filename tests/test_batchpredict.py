@@ -68,6 +68,49 @@ def test_batch_predict_matches_predict(device, monkeypatch):
         )
 
 
+def test_twotower_batch_predict_matches_predict():
+    """The two-tower batch path must equal per-query predict — including
+    seen-item filtering and unknown users."""
+    from predictionio_tpu.data.aggregator import BiMap as BM
+    from predictionio_tpu.templates.twotower.engine import (
+        Query as TTQuery,
+        TwoTowerAlgorithm,
+        TwoTowerParams,
+        TwoTowerServingModel,
+    )
+
+    rng = np.random.default_rng(3)
+    n_u, n_i, d = 30, 25, 8
+    uv = rng.normal(size=(n_u, d)).astype(np.float32)
+    iv = rng.normal(size=(n_i, d)).astype(np.float32)
+    model = TwoTowerServingModel(
+        user_vecs=uv,
+        item_vecs=iv,
+        user_index=BM({f"u{i}": i for i in range(n_u)}),
+        item_index=BM({f"i{i}": i for i in range(n_i)}),
+        seen={"u0": ("i1", "i2", "i3"), "u5": tuple(f"i{j}" for j in range(20))},
+    )
+    algo = TwoTowerAlgorithm(TwoTowerParams(embedding_dim=d))
+    queries = (
+        [(i, TTQuery(user=f"u{i}", num=4)) for i in range(10)]
+        + [(10, TTQuery(user="ghost", num=4))]
+        + [(11, TTQuery(user="u5", num=3))]   # heavy seen filtering
+        + [(12, TTQuery(user="u0", num=99))]  # num > catalog
+    )
+    got = dict(algo.batch_predict(model, queries))
+    assert set(got) == {i for i, _ in queries}
+    for i, q in queries:
+        want = algo.predict(model, q)
+        assert [s.item for s in got[i].item_scores] == [
+            s.item for s in want.item_scores
+        ], f"query {i}"
+        np.testing.assert_allclose(
+            [s.score for s in got[i].item_scores],
+            [s.score for s in want.item_scores],
+            rtol=1e-5,
+        )
+
+
 def test_batch_predict_empty_and_all_unknown():
     algo = ALSAlgorithm(ALSAlgorithmParams(rank=8))
     model = _model()

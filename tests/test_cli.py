@@ -2,6 +2,7 @@
 status, train+batchpredict through the console entry point."""
 
 import json
+import sys
 
 import pytest
 
@@ -216,3 +217,23 @@ class TestTemplateCommands:
             engine = variant.build_engine()
             ep = variant.engine_params(engine)  # binds params dataclasses
             assert ep.algorithms, name
+
+
+class TestRunAndUpgrade:
+    def test_run_injects_environment(self, memory_storage_env, tmp_path, capsys):
+        script = tmp_path / "probe.py"
+        script.write_text(
+            "import os, sys\n"
+            "import predictionio_tpu  # PYTHONPATH injected\n"
+            "sys.exit(0 if os.environ.get('PIO_FS_BASEDIR') else 3)\n"
+        )
+        rc = main(["run", "--", sys.executable, str(script)])
+        assert rc == 0  # probe exits 3 if PIO_FS_BASEDIR was not injected
+
+    def test_run_without_command_errors(self, memory_storage_env, capsys):
+        assert main(["run"]) == 1
+        assert "needs a command" in capsys.readouterr().err
+
+    def test_upgrade_prints_guidance(self, memory_storage_env, capsys):
+        assert main(["upgrade"]) == 0
+        assert "pip install -U" in capsys.readouterr().out
