@@ -266,11 +266,16 @@ def train_two_tower(
     mesh: Mesh | None = None,
     data_axis: str = "data",
     model_axis: str = "model",
+    init_user: np.ndarray | None = None,
+    init_item: np.ndarray | None = None,
 ) -> TwoTowerModel:
     """Train user/item towers from implicit interaction pairs.
 
     ``rows[i]``/``cols[i]`` is one (user, item) interaction. Returns
     L2-normalized tower vectors as replicated host-readable arrays.
+    ``init_user``/``init_item`` ([num_users, D] / [num_items, D]) seed
+    the embedding tables (warm retrain carry-over); rows beyond them
+    (shard padding) keep the random draw.
     """
     rows = np.asarray(rows, dtype=np.int64)
     cols = np.asarray(cols, dtype=np.int64)
@@ -304,6 +309,19 @@ def train_two_tower(
         "user": jax.random.normal(k_u, (n_u, D), jnp.float32) * scale,
         "item": jax.random.normal(k_i, (n_i, D), jnp.float32) * scale,
     }
+    for name, init, n_real in (
+        ("user", init_user, num_users), ("item", init_item, num_items)
+    ):
+        if init is None:
+            continue
+        init = np.asarray(init, np.float32)
+        if init.shape != (n_real, D):
+            raise ValueError(
+                f"init_{name} must have shape {(n_real, D)}, got {init.shape}"
+            )
+        base = np.array(params[name])  # copy: asarray of a jax array is read-only
+        base[:n_real] = init
+        params[name] = jnp.asarray(base)
     if mesh is not None:
         spec = (
             PartitionSpec(model_axis, None)

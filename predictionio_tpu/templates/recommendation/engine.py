@@ -646,43 +646,11 @@ class ALSAlgorithm(JaxAlgorithm):
         super().__init__(params)
 
     @staticmethod
-    def _aligned_init(
-        old_factors: np.ndarray,
-        old_index: BiMap,
-        new_index: BiMap,
-        rank: int,
-        seed: int,
-    ) -> tuple[np.ndarray, int]:
-        """Carry a previous model's factor rows over to the new id space:
-        entities present in both keep their vectors (overlapping columns
-        when the rank changed); new entities get the standard
-        abs(normal)/sqrt(rank) draw. This is what makes a warm retrain
-        start near the previous optimum even as the catalog shifts.
-        Returns (init matrix, number of carried rows)."""
-        rng = np.random.default_rng(seed)
-        out = (
-            np.abs(rng.standard_normal((len(new_index), rank)))
-            / np.sqrt(rank)
-        ).astype(np.float32)
-        old = np.asarray(old_factors)
-        k = min(rank, old.shape[1])
-        old_d, new_d = old_index.to_dict(), new_index.to_dict()
-        if not old_d or not new_d:
-            return out, 0
-        # vectorized key intersection — a per-key Python loop would cost
-        # minutes at catalog scale (review finding)
-        old_keys = np.asarray(list(old_d), dtype=np.str_)
-        old_rows = np.fromiter(old_d.values(), np.int64, len(old_d))
-        new_keys = np.asarray(list(new_d), dtype=np.str_)
-        new_rows = np.fromiter(new_d.values(), np.int64, len(new_d))
-        o_sort = np.argsort(old_keys)
-        pos = np.searchsorted(old_keys, new_keys, sorter=o_sort)
-        pos_c = np.minimum(pos, old_keys.size - 1)
-        hit = old_keys[o_sort[pos_c]] == new_keys
-        src = old_rows[o_sort[pos_c[hit]]]
-        ok = src < old.shape[0]
-        out[new_rows[hit][ok], :k] = old[src[ok], :k]
-        return out, int(ok.sum())
+    def _aligned_init(old_factors, old_index, new_index, rank, seed):
+        """See serving_util.aligned_factor_init (shared with two-tower)."""
+        from predictionio_tpu.templates.serving_util import aligned_factor_init
+
+        return aligned_factor_init(old_factors, old_index, new_index, rank, seed)
 
     def train(self, ctx: WorkflowContext, pd: TrainingData) -> ALSModel:
         p = self.params

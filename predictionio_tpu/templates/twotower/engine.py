@@ -29,6 +29,7 @@ engine.json::
 from __future__ import annotations
 
 import dataclasses
+import logging
 from typing import Any, Sequence
 
 import numpy as np
@@ -285,6 +286,31 @@ class TwoTowerAlgorithm(JaxAlgorithm):
 
     def train(self, ctx: WorkflowContext, pd: TrainingData) -> TwoTowerServingModel:
         p = self.params
+        init_user = init_item = None
+        warm = ctx.warm_model
+        if isinstance(warm, TwoTowerServingModel):
+            # same carry-over as the ALS template: entities present in
+            # both catalogs keep their embeddings; NEW ones draw the
+            # tower's own signed-normal cold init (not ALS's abs draw)
+            from predictionio_tpu.templates.serving_util import (
+                aligned_factor_init,
+            )
+
+            def fresh(rng, shape):
+                return rng.standard_normal(shape) / np.sqrt(shape[1])
+
+            init_user, n_u = aligned_factor_init(
+                warm.user_vecs, warm.user_index, pd.user_index,
+                p.embedding_dim, p.seed, fresh=fresh,
+            )
+            init_item, n_i = aligned_factor_init(
+                warm.item_vecs, warm.item_index, pd.item_index,
+                p.embedding_dim, p.seed + 1, fresh=fresh,
+            )
+            logging.getLogger(__name__).info(
+                "Warm start: carried %d/%d user and %d/%d item embeddings",
+                n_u, len(pd.user_index), n_i, len(pd.item_index),
+            )
         model = train_two_tower(
             pd.rows,
             pd.cols,
@@ -301,6 +327,8 @@ class TwoTowerAlgorithm(JaxAlgorithm):
                 fused_ce=p.fused_ce,
             ),
             mesh=ctx.mesh,
+            init_user=init_user,
+            init_item=init_item,
         )
         return TwoTowerServingModel(
             user_vecs=model.user_vecs,
