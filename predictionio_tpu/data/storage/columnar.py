@@ -145,6 +145,9 @@ class _Segment:
     propf: dict[str, np.ndarray]  # float64, NaN = absent
     propint: dict[str, np.ndarray]  # bool: value was an int
     extra: np.ndarray | None  # unicode JSON residue, "" = none
+    #: explicit per-row event ids (compacted-tail segments); None =
+    #: positional "<segment>@<row>" ids (bulk-written segments)
+    ids: np.ndarray | None = None
 
     def __len__(self) -> int:
         return int(self.ev_code.shape[0])
@@ -177,7 +180,10 @@ class _Segment:
             target_entity_id=str(self.tid_vocab[t_code]) if t_code >= 0 else None,
             properties=DataMap(props),
             event_time=_from_us(int(self.t_us[row])),
-            event_id=f"{self.name}@{row}",
+            event_id=(
+                str(self.ids[row]) if self.ids is not None
+                else f"{self.name}@{row}"
+            ),
             tags=tags,
             pr_id=pr_id,
             creation_time=_from_us(int(self.c_us[row])),
@@ -211,6 +217,7 @@ def _load_segment(path: str) -> _Segment:
         propf=propf,
         propint=propint,
         extra=data.get("extra"),
+        ids=data.get("ids"),
     )
 
 
@@ -231,6 +238,12 @@ class _ColumnarEvents(LEvents):
         from collections import OrderedDict
 
         self._seg_cache: "OrderedDict[str, _Segment]" = OrderedDict()
+        #: per-path event-id arrays for point lookups: None = positional
+        #: segment (cached indefinitely — a few bytes), ndarray =
+        #: explicit-id segment (LRU-bounded; ids of a huge segment are
+        #: tens of MB). Segments are immutable, so entries never go
+        #: stale; remove() drops them with the stream.
+        self._ids_cache: "OrderedDict[str, np.ndarray | None]" = OrderedDict()
         self._cache_segments = (
             self._CACHE_SEGMENTS if cache_segments is None else cache_segments
         )
@@ -285,6 +298,67 @@ class _ColumnarEvents(LEvents):
                 self._seg_cache.move_to_end(path)
             return seg
 
+    def _compactions(self, d: str) -> int:
+        try:
+            with open(os.path.join(d, "compactions")) as f:
+                return int(f.read().strip() or 0)
+        except (FileNotFoundError, ValueError):
+            return 0
+
+    def _recover(self, d: str) -> None:
+        """Finish (or discard) an interrupted compaction. Called under
+        the store lock before any read/write touches the stream.
+
+        Protocol: compact() stages new segments as ``*.pending``, then
+        atomically writes ``compact.commit`` (the commit point) listing
+        them, then renames them visible, truncates the tail, rewrites
+        tombstones, bumps the generation, and removes the marker. A
+        crash BEFORE the marker leaves only stray ``.pending`` files
+        (deleted here); a crash AFTER it is replayed here idempotently —
+        either way scans never see tail events twice or lose them."""
+        marker = os.path.join(d, "compact.commit")
+        if not os.path.exists(marker):  # fast path: nothing to recover
+            return
+        with open(marker) as f:
+            pending = json.load(f)["pending"]
+        for name in pending:
+            src = os.path.join(d, name + ".pending")
+            if os.path.exists(src):
+                os.replace(src, os.path.join(d, name))
+        self._finish_compact(d)
+
+    def _finish_compact(self, d: str) -> None:
+        """Post-commit tail truncation + tombstone GC + generation bump
+        (shared by compact() and crash recovery; idempotent)."""
+        tail_path = os.path.join(d, "tail.jsonl")
+        tmp = tail_path + ".tmp"
+        with open(tmp, "w") as f:
+            if self._fsync:
+                f.flush()
+                os.fsync(f.fileno())
+        os.replace(tmp, tail_path)
+        tomb = self._tombstones(d)
+        keep = sorted(t for t in tomb if not t.startswith("t:"))
+        tmp = os.path.join(d, "tombstones.txt.tmp")
+        with open(tmp, "w") as f:
+            f.write("".join(t + "\n" for t in keep))
+            if self._fsync:
+                f.flush()
+                os.fsync(f.fileno())
+        os.replace(tmp, os.path.join(d, "tombstones.txt"))
+        gen = self._compactions(d) + 1
+        tmp = os.path.join(d, "compactions.tmp")
+        with open(tmp, "w") as f:
+            f.write(str(gen))
+            if self._fsync:
+                f.flush()
+                os.fsync(f.fileno())
+        os.replace(tmp, os.path.join(d, "compactions"))
+        try:
+            os.remove(os.path.join(d, "compact.commit"))
+        except FileNotFoundError:
+            pass
+
     def _tombstones(self, d: str) -> set[str]:
         try:
             with open(os.path.join(d, "tombstones.txt")) as f:
@@ -312,6 +386,36 @@ class _ColumnarEvents(LEvents):
             if sep and row_s.isdigit():
                 seg_rows.setdefault(seg_name, set()).add(int(row_s))
         return tail_ids, seg_rows
+
+    def _snapshot(
+        self, d: str, count_tail_only: bool = False
+    ) -> tuple[list, Any, set]:
+        """Consistent (segment paths, raw tail lines, tombstones) taken
+        under the store lock. Scans must start from ONE such snapshot:
+        compaction moves events from the tail into a new segment, and a
+        lock-free reader interleaving the two reads would either lose
+        the moved events or count them twice. ``count_tail_only``
+        returns an int line count instead of the lines — scan_state on a
+        large uncompacted tail must not materialize it."""
+        with self._lock:
+            self._recover(d)
+            seg_paths = self._segment_paths(d)
+            lines: Any = 0 if count_tail_only else []
+            try:
+                with open(os.path.join(d, "tail.jsonl")) as f:
+                    if count_tail_only:
+                        lines = sum(1 for ln in f if ln.strip())
+                    else:
+                        lines = [ln for ln in f if ln.strip()]
+            except FileNotFoundError:
+                pass
+            tomb = self._tombstones(d)
+        return seg_paths, lines, tomb
+
+    @staticmethod
+    def _decode_tail_lines(lines: Sequence[str]) -> Iterator[Event]:
+        for line in lines:
+            yield _ColumnarEvents._decode_tail(json.loads(line))
 
     def _tail_events(self, d: str) -> Iterator[Event]:
         try:
@@ -355,6 +459,8 @@ class _ColumnarEvents(LEvents):
             shutil.rmtree(d)
             for p in [p for p in self._seg_cache if p.startswith(d)]:
                 del self._seg_cache[p]
+            for p in [p for p in self._ids_cache if p.startswith(d)]:
+                del self._ids_cache[p]
         return True
 
     def insert(self, event: Event, app_id: int, channel_id: int | None = None) -> str:
@@ -371,12 +477,71 @@ class _ColumnarEvents(LEvents):
             ids.append(eid)
             lines.append(self._encode_tail(e.with_event_id(eid)))
         with self._lock:
+            # an unreplayed compaction marker would truncate the tail on
+            # the next read — finish it BEFORE appending new lines
+            self._recover(d)
             with open(os.path.join(d, "tail.jsonl"), "a") as f:
                 f.write("".join(line + "\n" for line in lines))
                 if self._fsync:
                     f.flush()
                     os.fsync(f.fileno())
         return ids
+
+    def compact(self, app_id: int, channel_id: int | None = None) -> int:
+        """Seal the live JSONL tail into explicit-id segments and drop
+        the consumed tail tombstones. Event ids survive (the segments
+        carry an ``ids`` column), so acknowledged ids from POST
+        /events.json stay fetchable and deletable. Returns the number of
+        events moved.
+
+        The whole operation holds the store lock; in-process readers see
+        a consistent before/after via :meth:`_snapshot`. Incremental
+        readers (``scan_state`` manifests) are invalidated by the
+        tombstone-count/tail-length change and fall back to a full
+        re-read. NOT safe against concurrent writers in OTHER processes
+        (single-owner deployment, like the reference's HBase major
+        compaction)."""
+        d = self._ensure_stream(app_id, channel_id)
+        with self._lock:
+            self._recover(d)
+            for name in os.listdir(d):  # pre-commit crash garbage
+                if name.endswith(".pending") or name.endswith(".pending.tmp"):
+                    try:
+                        os.remove(os.path.join(d, name))
+                    except FileNotFoundError:
+                        pass
+            tomb = self._tombstones(d)
+            raw_ids, _ = self._split_tombstones(tomb)
+            tail = list(self._tail_events(d))
+            if not tail:
+                return 0
+            live = [e for e in tail if e.event_id not in raw_ids]
+            # stage new segments invisibly, then commit atomically: a
+            # crash before the marker leaves only .pending garbage, a
+            # crash after it is replayed by _recover — never duplicates
+            pending: list[str] = []
+            for lo in range(0, len(live), self._segment_rows):
+                path = self._next_segment_path(d)
+                name = os.path.basename(path)
+                self._write_segment_from_events(
+                    live[lo : lo + self._segment_rows], app_id, channel_id,
+                    keep_ids=True, path=path + ".pending",
+                )
+                pending.append(name)
+            marker = os.path.join(d, "compact.commit")
+            tmp = marker + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump({"pending": pending}, f)
+                if self._fsync:
+                    f.flush()
+                    os.fsync(f.fileno())
+            os.replace(tmp, marker)  # <- commit point
+            for name in pending:
+                os.replace(
+                    os.path.join(d, name + ".pending"), os.path.join(d, name)
+                )
+            self._finish_compact(d)
+        return len(live)
 
     def _lookup(
         self, event_id: str, d: str
@@ -394,28 +559,73 @@ class _ColumnarEvents(LEvents):
             if os.path.exists(path) and row_s.isdigit():
                 seg = self._segment(path)
                 row = int(row_s)
-                if row < len(seg):
+                if row < len(seg) and seg.ids is None:
                     return seg.row_event(row), False
+        # explicit-id (compacted) segments: match by stored id. Only the
+        # ids member is read per file (decoding whole segments for a
+        # point lookup would thrash the LRU cache), positional segments
+        # cache a None marker so repeat misses skip their files, and
+        # loaded ids arrays are LRU-cached
+        for path in self._segment_paths(d):
+            ids = self._segment_ids(path)
+            if ids is None:
+                continue
+            hits = np.flatnonzero(ids == event_id)
+            if hits.size:
+                return self._segment(path).row_event(int(hits[0])), False
         return None, False
+
+    def _segment_ids(self, path: str) -> np.ndarray | None:
+        with self._lock:
+            if path in self._ids_cache:
+                self._ids_cache.move_to_end(path)
+                return self._ids_cache[path]
+        seg = self._seg_cache.get(path)
+        if seg is not None:
+            ids = seg.ids
+        else:
+            with np.load(path, allow_pickle=False) as z:
+                ids = z["ids"] if "ids" in z.files else None
+        with self._lock:
+            self._ids_cache[path] = ids
+            # None markers are tiny; only bound the real arrays
+            real = [k for k, v in self._ids_cache.items() if v is not None]
+            while len(real) > max(self._cache_segments, 1):
+                victim = real.pop(0)
+                del self._ids_cache[victim]
+        return ids
+
+    def _is_dead(self, event_id: str, in_tail: bool, d: str) -> bool:
+        tail_ids, seg_rows = self._split_tombstones(self._tombstones(d))
+        if in_tail or event_id in tail_ids:
+            # tail events AND explicit-id segment rows are named by the
+            # raw/unprefixed id set
+            return event_id in tail_ids
+        seg_name, sep, row_s = event_id.rpartition("@")
+        return bool(
+            sep and row_s.isdigit()
+            and int(row_s) in seg_rows.get(seg_name, ())
+        )
 
     def get(self, event_id: str, app_id: int, channel_id: int | None = None) -> Event | None:
         d = self._stream_dir(app_id, channel_id)
+        with self._lock:
+            self._recover(d)
         event, in_tail = self._lookup(event_id, d)
-        if event is None:
-            return None
-        tail_ids, seg_rows = self._split_tombstones(self._tombstones(d))
-        if in_tail:
-            return None if event_id in tail_ids else event
-        seg_name, _, row_s = event_id.rpartition("@")
-        if int(row_s) in seg_rows.get(seg_name, ()):
+        if event is None or self._is_dead(event_id, in_tail, d):
             return None
         return event
 
     def delete(self, event_id: str, app_id: int, channel_id: int | None = None) -> bool:
         d = self._ensure_stream(app_id, channel_id)
-        if self.get(event_id, app_id, channel_id) is None:
+        with self._lock:
+            # replay any interrupted compaction BEFORE classifying the
+            # event: a tail hit followed by recovery's tombstone GC
+            # would silently undo this delete
+            self._recover(d)
+        event, in_tail = self._lookup(event_id, d)
+        if event is None or self._is_dead(event_id, in_tail, d):
             return False
-        _, in_tail = self._lookup(event_id, d)
         entry = f"t:{event_id}" if in_tail else event_id
         with self._lock:
             with open(os.path.join(d, "tombstones.txt"), "a") as f:
@@ -440,7 +650,8 @@ class _ColumnarEvents(LEvents):
         by (event_time, event_id). Materializes the matching set — bulk
         training must use :meth:`find_columns` instead."""
         d = self._stream_dir(app_id, channel_id)
-        tail_tomb, seg_tomb = self._split_tombstones(self._tombstones(d))
+        seg_paths, tail_lines, tomb = self._snapshot(d)
+        tail_tomb, seg_tomb = self._split_tombstones(tomb)
         out: list[Event] = []
 
         def keep(e: Event) -> bool:
@@ -449,17 +660,23 @@ class _ColumnarEvents(LEvents):
                 event_names, target_entity_type, target_entity_id,
             )
 
-        for path in self._segment_paths(d):
+        for path in seg_paths:
             seg = self._segment(path)
             rows = self._matching_rows(
                 seg, start_time, until_time, entity_type, entity_id,
                 event_names, target_entity_type, target_entity_id,
             )
+            if seg.ids is not None:
+                # explicit-id (compacted) segment: tombstones match by id
+                for row in rows:
+                    if str(seg.ids[int(row)]) not in tail_tomb:
+                        out.append(seg.row_event(int(row)))
+                continue
             dead = seg_tomb.get(seg.name, ())
             for row in rows:
                 if int(row) not in dead:
                     out.append(seg.row_event(int(row)))
-        for e in self._tail_events(d):
+        for e in self._decode_tail_lines(tail_lines):
             if e.event_id not in tail_tomb and keep(e):
                 out.append(e)
         out.sort(key=BaseStorageClient.sorted_events_key, reverse=reversed)
@@ -549,7 +766,8 @@ class _ColumnarEvents(LEvents):
         )
 
     def _write_segment_from_events(
-        self, events: Sequence[Event], app_id: int, channel_id: int | None
+        self, events: Sequence[Event], app_id: int, channel_id: int | None,
+        keep_ids: bool = False, path: str | None = None,
     ) -> None:
         ev, etype, eid, ttype, tid = [], [], [], [], []
         t_us, c_us = [], []
@@ -619,7 +837,13 @@ class _ColumnarEvents(LEvents):
             arrays[f"propint_{k}"] = was_int
         if any_extra:
             arrays["extra"] = np.asarray(extra_rows, dtype=np.str_)
-        self._save_segment(arrays, app_id, channel_id)
+        if keep_ids:
+            # compacted-tail segments keep their original event ids so
+            # acknowledged ids stay fetchable/deletable after compaction
+            arrays["ids"] = np.asarray(
+                [e.event_id or new_event_id() for e in events], dtype=np.str_
+            )
+        self._save_segment(arrays, app_id, channel_id, path=path)
 
     def write_columns(
         self,
@@ -702,12 +926,14 @@ class _ColumnarEvents(LEvents):
         return written
 
     def _save_segment(
-        self, arrays: dict[str, np.ndarray], app_id: int, channel_id: int | None
+        self, arrays: dict[str, np.ndarray], app_id: int, channel_id: int | None,
+        path: str | None = None,
     ) -> None:
         if arrays["ev_code"].shape[0] == 0:
             return
         d = self._ensure_stream(app_id, channel_id)
-        path = self._next_segment_path(d)
+        if path is None:
+            path = self._next_segment_path(d)
         tmp = path + ".tmp"
         with open(tmp, "wb") as f:
             np.savez(f, **arrays)
@@ -724,20 +950,18 @@ class _ColumnarEvents(LEvents):
         ``tail_skip`` on :meth:`find_columns`), provided the tombstone
         count is unchanged and its recorded segments still exist."""
         d = self._stream_dir(app_id, channel_id)
-        tail_lines = 0
-        try:
-            with open(os.path.join(d, "tail.jsonl")) as f:
-                tail_lines = sum(1 for line in f if line.strip())
-        except FileNotFoundError:
-            pass
+        seg_paths, n_tail, tomb = self._snapshot(d, count_tail_only=True)
         return {
             "stream_id": self._stream_id(d),
             "segments": sorted(
-                os.path.splitext(os.path.basename(p))[0]
-                for p in self._segment_paths(d)
+                os.path.splitext(os.path.basename(p))[0] for p in seg_paths
             ),
-            "tail_lines": tail_lines,
-            "tombstones": len(self._tombstones(d)),
+            "tail_lines": n_tail,
+            "tombstones": len(tomb),
+            # bumps on every compaction: incremental manifests recorded
+            # before one must NOT validate after it (the tail was
+            # consumed; a regrown tail would otherwise alias tail_skip)
+            "compactions": self._compactions(d),
         }
 
     def find_columns(
@@ -762,7 +986,8 @@ class _ColumnarEvents(LEvents):
         files and ``tail_skip`` skips the first N tail lines — the delta
         read of an incremental re-index (see :meth:`scan_state`)."""
         d = self._stream_dir(app_id, channel_id)
-        tail_tomb, tomb_rows = self._split_tombstones(self._tombstones(d))
+        seg_paths, tail_lines, tomb = self._snapshot(d)
+        tail_tomb, tomb_rows = self._split_tombstones(tomb)
 
         ev_parts: list[tuple[np.ndarray, np.ndarray]] = []
         ent_parts: list[tuple[np.ndarray, np.ndarray]] = []
@@ -770,7 +995,6 @@ class _ColumnarEvents(LEvents):
         times: list[np.ndarray] = []
         props: list[np.ndarray] = []
 
-        seg_paths = self._segment_paths(d)
         if segments is not None:
             wanted = set(segments)
             seg_paths = [
@@ -784,9 +1008,13 @@ class _ColumnarEvents(LEvents):
                 seg, start_time, until_time, entity_type, None,
                 event_names, target_entity_type, None,
             )
-            dead = tomb_rows.get(seg.name)
-            if dead:
-                mask[list(dead)] = False
+            if seg.ids is not None:
+                if tail_tomb:
+                    mask &= ~np.isin(seg.ids, list(tail_tomb))
+            else:
+                dead = tomb_rows.get(seg.name)
+                if dead:
+                    mask[list(dead)] = False
             if mask.all():
                 rows = slice(None)  # whole segment: skip the index gather
                 n_rows = len(seg)
@@ -821,7 +1049,7 @@ class _ColumnarEvents(LEvents):
 
         tail = [
             e
-            for j, e in enumerate(self._tail_events(d))
+            for j, e in enumerate(self._decode_tail_lines(tail_lines))
             if j >= tail_skip
             and e.event_id not in tail_tomb
             and BaseStorageClient.match_filters(
@@ -911,6 +1139,9 @@ class _ColumnarPEvents(PEvents):
 
     def write_columns(self, app_id: int, channel_id: int | None = None, **kw) -> int:
         return self._e.write_columns(app_id, channel_id, **kw)
+
+    def compact(self, app_id: int, channel_id: int | None = None) -> int:
+        return self._e.compact(app_id, channel_id)
 
     def find_columns(self, app_id: int, channel_id: int | None = None, **kw):
         return self._e.find_columns(app_id, channel_id, **kw)

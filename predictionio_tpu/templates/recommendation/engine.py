@@ -377,6 +377,11 @@ class RecommendationDataSource(DataSource):
             or meta.get("tombstones") != state["tombstones"]
             or not cached_segments.issubset(set(state["segments"]))
             or meta.get("tail_lines", 0) > state["tail_lines"]
+            # a compaction CONSUMED the recorded tail lines; once the
+            # tail regrows past the recorded length, tail_skip would
+            # silently skip genuinely new events — the generation
+            # counter makes any pre-compaction manifest stale
+            or meta.get("compactions", 0) != state.get("compactions", 0)
         ):
             return None
         new_segments = [
@@ -405,6 +410,16 @@ class RecommendationDataSource(DataSource):
             segments=new_segments,
             tail_skip=int(meta.get("tail_lines", 0)),
         )
+        # TOCTOU guard: a compaction landing between the scan_state above
+        # and this delta read moves the uncached tail lines into a
+        # segment that is NOT in new_segments while emptying the tail —
+        # the delta would silently miss them. Each storage call is
+        # snapshot-consistent on its own; the two-call sequence is only
+        # valid if the generation did not move underneath it.
+        if pe.scan_state(app_id).get("compactions", 0) != state.get(
+            "compactions", 0
+        ):
+            return None
         du, di, dt_us, dv = self._extract_ratings_arrays(delta)
         if du.size == 0:
             # nothing new: the cache IS the training data — skip the

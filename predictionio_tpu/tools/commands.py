@@ -112,8 +112,8 @@ def app_delete(name: str, out: Out = _print) -> None:
     out(f"Deleted app {name}.")
 
 
-def app_data_delete(name: str, channel: str | None = None, out: Out = _print) -> None:
-    """``pio app data-delete`` — wipe events, keep the app."""
+def _resolve_app_channel(name: str, channel: str | None):
+    """(app, channel_id) for commands addressing one app's stream."""
     app = Storage.get_meta_data_apps().get_by_name(name)
     if app is None:
         raise StorageError(f"App '{name}' does not exist.")
@@ -126,10 +126,32 @@ def app_data_delete(name: str, channel: str | None = None, out: Out = _print) ->
         if not matches:
             raise StorageError(f"Channel '{channel}' does not exist.")
         channel_id = matches[0].id
+    return app, channel_id
+
+
+def app_data_delete(name: str, channel: str | None = None, out: Out = _print) -> None:
+    """``pio app data-delete`` — wipe events, keep the app."""
+    app, channel_id = _resolve_app_channel(name, channel)
     le = Storage.get_l_events()
     le.remove(app.id, channel_id)
     le.init(app.id, channel_id)
     out(f"Deleted data of app {name}" + (f" channel {channel}." if channel else "."))
+
+
+def app_compact(name: str, channel: str | None = None, out: Out = _print) -> int:
+    """``pio app compact`` — seal the columnar event tail into segments
+    (the HBase major-compaction role). Event ids survive. No-op error on
+    backends without a tail/segment layout."""
+    app, channel_id = _resolve_app_channel(name, channel)
+    le = Storage.get_l_events()
+    if not hasattr(le, "compact"):
+        raise StorageError(
+            "The configured EVENTDATA backend has no tail to compact "
+            "(compaction applies to the columnar driver)."
+        )
+    moved = le.compact(app.id, channel_id)
+    out(f"Compacted {moved} tail events of app {name} into segments.")
+    return moved
 
 
 # --------------------------------------------------------------- channels

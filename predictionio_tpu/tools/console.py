@@ -42,10 +42,10 @@ def build_parser() -> argparse.ArgumentParser:
     ap_new.add_argument("--description")
     ap_new.add_argument("--access-key", default="")
     app_sub.add_parser("list")
-    for cmd in ("show", "delete", "data-delete"):
+    for cmd in ("show", "delete", "data-delete", "compact"):
         sp = app_sub.add_parser(cmd)
         sp.add_argument("name")
-        if cmd == "data-delete":
+        if cmd in ("data-delete", "compact"):
             sp.add_argument("--channel")
     ch_new = app_sub.add_parser("channel-new")
     ch_new.add_argument("name")
@@ -337,6 +337,8 @@ def main(argv: list[str] | None = None) -> int:
                 commands.app_delete(args.name)
             elif ac == "data-delete":
                 commands.app_data_delete(args.name, args.channel)
+            elif ac == "compact":
+                commands.app_compact(args.name, args.channel)
             elif ac == "channel-new":
                 commands.channel_new(args.name, args.channel)
             elif ac == "channel-delete":

@@ -579,3 +579,188 @@ class TestTwoTowerColumnarRead:
             assert td_fast.seen == td_slow.seen
         finally:
             Storage.configure(None)
+
+
+class TestCompaction:
+    """`compact()` seals the live tail into explicit-id segments (VERDICT
+    r5: the documented tail-growth gap, now closed): ids survive, dead
+    tail events drop, spent tombstones are garbage-collected, and the
+    incremental manifest invalidates safely."""
+
+    def _client(self, tmp_path, segment_rows=8):
+        from predictionio_tpu.data.storage import columnar
+        from predictionio_tpu.data.storage.base import StorageClientConfig
+
+        return columnar.StorageClient(
+            StorageClientConfig(
+                "C", "columnar",
+                {"path": str(tmp_path / "cc"),
+                 "segment_rows": str(segment_rows)},
+            )
+        )
+
+    def _ev(self, i):
+        from predictionio_tpu.data.event import DataMap, Event
+
+        return Event(
+            event="rate", entity_type="user", entity_id=f"u{i % 5}",
+            target_entity_type="item", target_entity_id=f"i{i % 3}",
+            properties=DataMap({"rating": float(i % 5 + 1)}),
+        )
+
+    def test_ids_survive_and_remain_deletable(self, tmp_path):
+        c = self._client(tmp_path)
+        le = c.get_l_events()
+        le.init(7)
+        ids = [le.insert(self._ev(i), 7) for i in range(20)]
+        dead = ids[3]
+        assert le.delete(dead, 7)
+        moved = le.compact(7)
+        assert moved == 19  # the tombstoned event is dropped, not moved
+        # tail is empty; events now live in segments
+        assert le.scan_state(7)["tail_lines"] == 0
+        assert len(le.scan_state(7)["segments"]) >= 3  # 19 rows / 8
+        # spent t: tombstone was garbage-collected
+        assert le.scan_state(7)["tombstones"] == 0
+        # every acknowledged id still resolves to the same event
+        for i, eid in enumerate(ids):
+            got = le.get(eid, 7)
+            if eid == dead:
+                assert got is None
+                continue
+            assert got is not None and got.event_id == eid
+            assert got.entity_id == f"u{i % 5}"
+        # post-compaction deletes by original id still work
+        assert le.delete(ids[5], 7)
+        assert le.get(ids[5], 7) is None
+        assert len(list(le.find(7))) == 18
+        # and the columnar training read agrees
+        assert len(c.get_p_events().find_columns(7, prop="rating")) == 18
+        c.close()
+
+    def test_compact_empty_and_idempotent(self, tmp_path):
+        c = self._client(tmp_path)
+        le = c.get_l_events()
+        le.init(7)
+        assert le.compact(7) == 0
+        le.insert(self._ev(0), 7)
+        assert le.compact(7) == 1
+        assert le.compact(7) == 0  # nothing left in the tail
+        assert len(list(le.find(7))) == 1
+        c.close()
+
+    def test_incremental_manifest_invalidates_even_after_tail_regrows(
+        self, tmp_path
+    ):
+        """The review-found aliasing hazard: a manifest recorded before
+        compaction must stay stale even once the tail REGROWS past the
+        recorded length (tail_skip would otherwise silently skip new
+        events). The generation counter is what breaks the alias."""
+        c = self._client(tmp_path)
+        le = c.get_l_events()
+        le.init(7)
+        for i in range(10):
+            le.insert(self._ev(i), 7)
+        before = le.scan_state(7)
+        le.compact(7)
+        after = le.scan_state(7)
+        assert before["tail_lines"] > after["tail_lines"]
+        assert set(before["segments"]) <= set(after["segments"])
+        assert after["compactions"] == before["compactions"] + 1
+        # regrow the tail past the recorded length: every legacy check
+        # (tombstones equal, segments subset, tail_lines not shrunk)
+        # would now pass — only the generation catches it
+        for i in range(12):
+            le.insert(self._ev(100 + i), 7)
+        regrown = le.scan_state(7)
+        assert regrown["tail_lines"] >= before["tail_lines"]
+        assert regrown["tombstones"] == before["tombstones"]
+        assert set(before["segments"]) <= set(regrown["segments"])
+        assert regrown["compactions"] != before["compactions"]
+        c.close()
+
+    def test_crash_recovery_replays_or_discards(self, tmp_path):
+        """Crash atomicity: a commit marker left by a killed compaction
+        is replayed on the next access (no duplicates, no loss); stray
+        pre-commit .pending files are discarded by the next compact."""
+        import json as _json
+        import os as _os
+
+        c = self._client(tmp_path)
+        le = c.get_l_events()
+        le.init(7)
+        ids = [le.insert(self._ev(i), 7) for i in range(6)]
+        d = le._stream_dir(7, None)
+
+        # simulate a crash AFTER the commit point: stage the pending
+        # segment + marker exactly as compact() would, then "die" before
+        # the rename/truncate
+        live = list(le._tail_events(d))
+        path = le._next_segment_path(d)
+        name = _os.path.basename(path)
+        le._write_segment_from_events(live, 7, None, keep_ids=True,
+                                      path=path + ".pending")
+        with open(_os.path.join(d, "compact.commit"), "w") as f:
+            _json.dump({"pending": [name]}, f)
+        # next scan triggers recovery: exactly 6 events, ids intact
+        got = list(le.find(7))
+        assert len(got) == 6
+        assert {e.event_id for e in got} == set(ids)
+        assert le.scan_state(7)["tail_lines"] == 0
+        assert le.scan_state(7)["compactions"] == 1
+        assert not _os.path.exists(_os.path.join(d, "compact.commit"))
+
+        # stray PRE-commit .pending (no marker) must not surface events
+        le.insert(self._ev(50), 7)
+        live = list(le._tail_events(d))
+        path2 = le._next_segment_path(d)
+        le._write_segment_from_events(live, 7, None, keep_ids=True,
+                                      path=path2 + ".pending")
+        assert len(list(le.find(7))) == 7  # pending invisible
+        le.compact(7)  # sweeps the stray, then compacts normally
+        assert len(list(le.find(7))) == 7
+        assert not any(
+            n.endswith(".pending") for n in _os.listdir(d)
+        )
+        c.close()
+
+    def test_cli_app_compact(self, tmp_path, monkeypatch):
+        from predictionio_tpu.data.storage import Storage
+        from predictionio_tpu.tools import commands
+
+        Storage.configure({
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "COL",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+            "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+            "PIO_STORAGE_SOURCES_COL_TYPE": "columnar",
+            "PIO_STORAGE_SOURCES_COL_PATH": str(tmp_path / "cols"),
+        })
+        try:
+            out: list[str] = []
+            commands.app_new("capp", out=out.append)
+            for i in range(5):
+                Storage.get_l_events().insert(self._ev(i), 1)
+            moved = commands.app_compact("capp", out=out.append)
+            assert moved == 5
+            assert "Compacted 5" in out[-1]
+        finally:
+            Storage.configure(None)
+
+    def test_cli_compact_rejected_on_non_columnar(self):
+        from predictionio_tpu.data.storage import Storage, StorageError
+        from predictionio_tpu.tools import commands
+
+        Storage.configure({
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+            "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+        })
+        try:
+            out: list[str] = []
+            commands.app_new("mapp", out=out.append)
+            with pytest.raises(StorageError, match="no tail to compact"):
+                commands.app_compact("mapp", out=out.append)
+        finally:
+            Storage.configure(None)

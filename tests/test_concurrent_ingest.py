@@ -150,17 +150,35 @@ def test_concurrent_writers_and_reader_lose_nothing(backend, tmp_path):
             except Exception as e:
                 errors.append(f"reader: {type(e).__name__}: {e}")
 
+        def compactor() -> None:
+            """Columnar only: seal the tail repeatedly WHILE writers
+            append and the reader scans — the snapshot consistency of
+            find() vs compact() is exactly what this thread attacks."""
+            try:
+                le = Storage.get_l_events()
+                while not stop_reader.is_set():
+                    if hasattr(le, "compact"):
+                        le.compact(app_id)
+                    time.sleep(0.02)
+            except Exception as e:
+                errors.append(f"compactor: {type(e).__name__}: {e}")
+
+        import time
+
         threads = [
             threading.Thread(target=writer, args=(w,)) for w in range(N_WRITERS)
         ]
         rt = threading.Thread(target=reader)
+        ct = threading.Thread(target=compactor)
         rt.start()
+        ct.start()
         for t in threads:
             t.start()
         for t in threads:
             t.join(timeout=120)
         stop_reader.set()
         rt.join(timeout=30)
+        ct.join(timeout=30)
         server.shutdown()
         server.server_close()
 
