@@ -526,6 +526,14 @@ class _RemoteLEvents(LEvents):
             },
         )
 
+    def compact(self, app_id: int, channel_id: int | None = None) -> int:
+        """Proxy of the columnar driver's tail compaction; StorageError
+        when the backing store has no tail/segment layout."""
+        return self._rpc.call(
+            "l_events", "compact",
+            {"app_id": app_id, "channel_id": channel_id},
+        )
+
     def get(self, event_id: str, app_id: int, channel_id: int | None = None) -> Event | None:
         d = self._rpc.call(
             "l_events", "get",
@@ -731,7 +739,7 @@ class StorageRpcService:
         "l_events": frozenset(
             (
                 "init", "remove", "insert", "insert_batch", "get",
-                "delete", "find", "find_page",
+                "delete", "find", "find_page", "compact",
             )
         ),
         "p_events": frozenset(("find", "find_page", "write", "delete")),
@@ -767,6 +775,10 @@ class StorageRpcService:
         if method not in self._METHODS.get(role, frozenset()):
             raise StorageError(f"unknown method '{role}.{method}'")
         repo = self._repo(role)
+        if method == "compact" and not hasattr(repo, "compact"):
+            raise StorageError(
+                "the backing EVENTDATA store has no tail to compact"
+            )
         # find_page is a server-layer verb over the repo's find iterator,
         # not an SPI method — resolved after arg decoding below
         fn = None if method == "find_page" else getattr(repo, method)
