@@ -360,6 +360,70 @@ class TestIncrementalReindex:
         finally:
             Storage.configure(None)
 
+    def test_compaction_invalidates_cache_and_regrown_tail(
+        self, tmp_path, monkeypatch
+    ):
+        """A compaction between trains must force a correct (full)
+        re-read — including the aliasing case where the tail regrows
+        past the cached length, which every legacy check would miss."""
+        from predictionio_tpu.data.storage import Storage
+
+        app_id = self._setup(tmp_path, monkeypatch)
+        try:
+            le = Storage.get_l_events()
+            for e in _mk_events(40, seed=8):
+                le.insert(e, app_id)
+            self._read()  # cache records tail_lines=40, compactions=0
+            le.compact(app_id)
+            # regrow the tail PAST the recorded length with new events
+            for e in _mk_events(55, seed=9):
+                le.insert(e, app_id)
+            td_inc = self._read()
+            td_full = self._read(incremental=False)
+            assert self._td_sets(td_inc) == self._td_sets(td_full)
+        finally:
+            Storage.configure(None)
+
+    def test_compaction_between_scan_state_and_delta_read(
+        self, tmp_path, monkeypatch
+    ):
+        """TOCTOU guard (review finding): a compaction landing between
+        _try_incremental's scan_state and its delta find_columns moves
+        the uncached tail into a segment outside new_segments — the
+        generation recheck must reject the delta and fall back to a full
+        read instead of silently dropping those events."""
+        from predictionio_tpu.data.storage import Storage
+
+        app_id = self._setup(tmp_path, monkeypatch)
+        try:
+            le = Storage.get_l_events()
+            pe = Storage.get_p_events()
+            for e in _mk_events(40, seed=10):
+                le.insert(e, app_id)
+            self._read()  # cache
+            for e in _mk_events(25, seed=11):  # uncached tail events
+                le.insert(e, app_id)
+
+            real_find_columns = type(pe).find_columns
+            fired = {"n": 0}
+
+            def compact_then_find(self_pe, *a, **kw):
+                if kw.get("segments") is not None and fired["n"] == 0:
+                    # first DELTA read of this test: compact mid-flight
+                    fired["n"] += 1
+                    le.compact(app_id)
+                return real_find_columns(self_pe, *a, **kw)
+
+            monkeypatch.setattr(type(pe), "find_columns", compact_then_find)
+            td_inc = self._read()
+            monkeypatch.setattr(type(pe), "find_columns", real_find_columns)
+            td_full = self._read(incremental=False)
+            assert fired["n"] == 1, "delta read never happened"
+            assert self._td_sets(td_inc) == self._td_sets(td_full)
+            assert len(td_inc.rows) == len(td_full.rows)
+        finally:
+            Storage.configure(None)
+
     def test_store_recreation_invalidates_cache(self, tmp_path, monkeypatch):
         from predictionio_tpu.data.storage import Storage
 
