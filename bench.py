@@ -23,7 +23,10 @@ Env knobs: BENCH_NNZ (default 20_000_000 on TPU), BENCH_RANK (64),
 BENCH_ITERS (timed sweeps; default 10 on accelerators = the default
 ALSConfig.iterations, so end-to-end numbers reflect a real train),
 BENCH_SERVING=0 to skip the serving bench, BENCH_SERVING_REQUESTS
-(default 1000), BENCH_PRECISION (default "highest"; "default" = bf16).
+(default 1000), BENCH_PRECISION (default "highest"; "default" = bf16),
+BENCH_CONCURRENT=0 to skip the concurrent-serving section,
+BENCH_CONCURRENT_CLIENTS (default 32), BENCH_CONCURRENT_REQUESTS
+(per client, default 100), BENCH_BATCH_DELAY_MS (default 2.0).
 """
 
 from __future__ import annotations
@@ -700,6 +703,214 @@ def _bench_batchpredict(on_accel: bool) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# Concurrent serving throughput: per-request baseline vs the micro-batcher
+# (ISSUE 1 — the cross-request dynamic batching serving runtime)
+# ---------------------------------------------------------------------------
+
+
+def _bench_serving_concurrent(n_clients: int, per_client: int) -> dict:
+    """N keep-alive HTTP clients hammer ``POST /queries.json`` twice: once
+    against the per-request path (every request pays its own dispatch,
+    serialized by the GIL/device) and once through the micro-batcher
+    (``pio deploy --batching``) with all bucket shapes pre-warmed.
+    Reports aggregate queries/sec, latency percentiles, the batcher's
+    latency decomposition, and ``bucket_misses_after_warmup`` (0 == no
+    recompiles under live traffic)."""
+    import http.client
+    import threading
+
+    from predictionio_tpu.api.http import start_background
+    from predictionio_tpu.controller import local_context
+    from predictionio_tpu.data.event import DataMap, Event
+    from predictionio_tpu.data.storage import Storage
+    from predictionio_tpu.data.storage.base import App
+    from predictionio_tpu.serving import BatcherConfig
+    from predictionio_tpu.workflow import load_engine_variant, run_train
+    from predictionio_tpu.workflow.serving import QueryService
+
+    # ML-20M-shaped catalog by default: at 27k items × rank 64 a query is
+    # a real GEMM slice, so the measurement exercises the amortization the
+    # batcher exists for (a toy catalog's GEMV is cheaper than the Python
+    # request overhead and the comparison degenerates into thread noise)
+    num_users = int(os.environ.get("BENCH_CONC_USERS", 5_000))
+    num_items = int(os.environ.get("BENCH_CONC_ITEMS", 27_000))
+    n_events = int(os.environ.get("BENCH_CONC_EVENTS", 200_000))
+    delay_ms = float(os.environ.get("BENCH_BATCH_DELAY_MS", 2.0))
+    max_batch = min(32, max(1, n_clients))
+    Storage.configure(
+        {
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+            "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+        }
+    )
+    try:
+        app_id = Storage.get_meta_data_apps().insert(App(id=0, name="bench-conc"))
+        rng = np.random.default_rng(3)
+        users = rng.integers(0, num_users, n_events)
+        items = rng.integers(0, num_items, n_events)
+        Storage.get_p_events().write(
+            (
+                Event(
+                    event="rate",
+                    entity_type="user",
+                    entity_id=str(u),
+                    target_entity_type="item",
+                    target_entity_id=str(i),
+                    properties=DataMap({"rating": float((u + i) % 5 + 1)}),
+                )
+                for u, i in zip(users, items)
+            ),
+            app_id,
+        )
+        variant = load_engine_variant(
+            {
+                "id": "bench-conc",
+                "version": "1",
+                "engineFactory": "predictionio_tpu.templates."
+                "recommendation:engine_factory",
+                "datasource": {"params": {"appName": "bench-conc"}},
+                "algorithms": [
+                    {
+                        "name": "als",
+                        "params": {
+                            "rank": 64,
+                            "numIterations": 2,
+                            "lambda": 0.05,
+                            "seed": 3,
+                        },
+                    }
+                ],
+            }
+        )
+        run_train(variant, local_context())
+
+        def run_load(qs: QueryService) -> dict:
+            server, _ = start_background(qs.dispatch, host="127.0.0.1", port=0)
+            try:
+                port = server.server_address[1]
+                # warm the HTTP path + predict caches before timing
+                warm_conn = http.client.HTTPConnection(
+                    "127.0.0.1", port, timeout=60
+                )
+                warm_body = json.dumps({"user": "0", "num": 10}).encode()
+                for _ in range(20):
+                    warm_conn.request(
+                        "POST", "/queries.json", body=warm_body,
+                        headers={"Content-Type": "application/json"},
+                    )
+                    warm_conn.getresponse().read()
+                warm_conn.close()
+
+                barrier = threading.Barrier(n_clients + 1)
+                lat: list[list[float]] = [[] for _ in range(n_clients)]
+                errors: list[int] = []
+
+                def client(cid: int) -> None:
+                    conn = http.client.HTTPConnection(
+                        "127.0.0.1", port, timeout=120
+                    )
+                    crng = np.random.default_rng(100 + cid)
+                    q_users = crng.integers(0, num_users, per_client)
+                    barrier.wait()
+                    for u in q_users:
+                        body = json.dumps(
+                            {"user": str(int(u)), "num": 10}
+                        ).encode()
+                        t0 = time.perf_counter()
+                        try:
+                            conn.request(
+                                "POST", "/queries.json", body=body,
+                                headers={"Content-Type": "application/json"},
+                            )
+                            resp = conn.getresponse()
+                            resp.read()
+                        except Exception:
+                            # dead connection: count it and stop this
+                            # client rather than silently inflating q/s
+                            errors.append(-1)
+                            break
+                        if resp.status != 200:
+                            # rejects (e.g. 429 shed load) must not count
+                            # toward throughput or latency — a cheap 429
+                            # is not a served query
+                            errors.append(resp.status)
+                            continue
+                        lat[cid].append(time.perf_counter() - t0)
+                    conn.close()
+
+                threads = [
+                    threading.Thread(target=client, args=(c,))
+                    for c in range(n_clients)
+                ]
+                for t in threads:
+                    t.start()
+                barrier.wait()
+                t0 = time.perf_counter()
+                for t in threads:
+                    t.join()
+                wall = time.perf_counter() - t0
+            finally:
+                server.shutdown()
+                server.server_close()
+            lat_ms = np.concatenate([np.asarray(l) for l in lat]) * 1e3
+            # only round trips that actually completed count as throughput
+            completed = int(sum(len(l) for l in lat))
+            return {
+                "queries_per_sec": round(completed / wall, 1),
+                "wall_seconds": round(wall, 2),
+                "requests": completed,
+                "requests_attempted": n_clients * per_client,
+                "errors": len(errors),
+                "p50_ms": round(float(np.percentile(lat_ms, 50)), 3),
+                "p95_ms": round(float(np.percentile(lat_ms, 95)), 3),
+                "p99_ms": round(float(np.percentile(lat_ms, 99)), 3),
+            }
+
+        qs_base = QueryService(variant)
+        baseline = run_load(qs_base)
+
+        qs_batched = QueryService(
+            variant,
+            batching=BatcherConfig(
+                max_batch_size=max_batch,
+                max_batch_delay_ms=delay_ms,
+                max_queue=max(256, 4 * n_clients),
+                warmup_body={"user": "0", "num": 10},
+            ),
+        )
+        try:
+            batched = run_load(qs_batched)
+            stats = qs_batched.batcher.stats.to_json()
+        finally:
+            qs_batched.close()
+        batched["batcher"] = {
+            "mean_batch_size": stats["meanBatchSize"],
+            "batches": stats["batches"],
+            "bucket_hist": stats["bucketHist"],
+            "bucket_misses_after_warmup": stats["bucketMisses"],
+            "padding_overhead": stats["paddingOverhead"],
+            "latency_decomposition_ms": stats["latencyMs"],
+        }
+        return {
+            "concurrency": n_clients,
+            "max_batch_size": max_batch,
+            "max_batch_delay_ms": delay_ms,
+            "per_request_baseline": baseline,
+            "micro_batched": batched,
+            "speedup": round(
+                batched["queries_per_sec"]
+                / max(baseline["queries_per_sec"], 1e-9),
+                3,
+            ),
+            "added_p99_ms": round(batched["p99_ms"] - baseline["p99_ms"], 3),
+        }
+    finally:
+        Storage.configure(None)
+
+
+# ---------------------------------------------------------------------------
 # Serving latency over real HTTP (p50 target: < 10 ms, BASELINE.md)
 # ---------------------------------------------------------------------------
 
@@ -933,6 +1144,12 @@ def main() -> None:
         os.environ["BENCH_TWOTOWER"] = "1"
         os.environ["BENCH_BATCHPREDICT"] = "1"
         os.environ["BENCH_BP_QUERIES"] = "1000"
+        os.environ["BENCH_CONCURRENT"] = "1"
+        os.environ["BENCH_CONCURRENT_CLIENTS"] = "32"
+        os.environ["BENCH_CONCURRENT_REQUESTS"] = "8"
+        os.environ["BENCH_CONC_EVENTS"] = "4000"
+        os.environ["BENCH_CONC_USERS"] = "500"
+        os.environ["BENCH_CONC_ITEMS"] = "2000"
         os.environ.pop("BENCH_PRECISION_COMPARE", None)
         # fresh compile cache: a persistent cache populated on a different
         # host can carry AOT results whose CPU features mismatch (SIGILL risk)
@@ -1013,6 +1230,16 @@ def main() -> None:
             detail["serving_latency"] = _bench_serving(n_req)
         except Exception as e:
             detail["serving_latency"] = {"error": str(e)[:200]}
+
+    if os.environ.get("BENCH_CONCURRENT", "1") != "0":
+        n_clients = int(os.environ.get("BENCH_CONCURRENT_CLIENTS", 32))
+        per_client = int(os.environ.get("BENCH_CONCURRENT_REQUESTS", 100))
+        try:
+            detail["serving_concurrent"] = _bench_serving_concurrent(
+                n_clients, per_client
+            )
+        except Exception as e:
+            detail["serving_concurrent"] = {"error": str(e)[:300]}
 
     if os.environ.get("BENCH_BATCHPREDICT", "1") != "0":
         try:

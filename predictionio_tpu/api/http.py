@@ -124,6 +124,7 @@ def _make_handler(dispatch: Dispatcher):
                 resp.status,
                 resp.json_bytes(),
                 getattr(resp, "content_type", "application/json; charset=UTF-8"),
+                getattr(resp, "headers", None),
             )
 
         def _send(
@@ -131,10 +132,13 @@ def _make_handler(dispatch: Dispatcher):
             status: int,
             payload: bytes,
             content_type: str = "application/json; charset=UTF-8",
+            extra_headers: Mapping[str, str] | None = None,
         ):
             self.send_response(status)
             self.send_header("Content-Type", content_type)
             self.send_header("Content-Length", str(len(payload)))
+            for k, v in (extra_headers or {}).items():
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(payload)
 
@@ -143,13 +147,22 @@ def _make_handler(dispatch: Dispatcher):
     return Handler
 
 
+class _Server(ThreadingHTTPServer):
+    #: listen(2) backlog. http.server's default of 5 overflows the SYN
+    #: queue the moment a few dozen clients connect at once (measured:
+    #: 1 s / 3 s latency cliffs from kernel SYN retransmission plus
+    #: outright connection resets at concurrency 32); serving millions
+    #: of users means absorbing connect storms at the accept queue.
+    request_queue_size = 128
+
+
 def _make_server(
     dispatch: Dispatcher,
     host: str,
     port: int,
     ssl_context: ssl.SSLContext | None,
 ) -> ThreadingHTTPServer:
-    server = ThreadingHTTPServer((host, port), _make_handler(dispatch))
+    server = _Server((host, port), _make_handler(dispatch))
     if ssl_context is not None:
         # defer the handshake to the per-connection worker thread: with
         # do_handshake_on_connect=True it would run inside accept() on

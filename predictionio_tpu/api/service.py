@@ -26,7 +26,8 @@ import logging
 import os
 import threading
 import time
-from typing import Any, Mapping
+import weakref
+from typing import Any, Iterable, Mapping
 
 from predictionio_tpu.api.stats import Stats
 from predictionio_tpu.api.webhooks import (
@@ -44,17 +45,46 @@ from predictionio_tpu.data.event import (
 )
 from predictionio_tpu.data.storage import Storage
 
-__all__ = ["Response", "EventService", "MAX_BATCH_SIZE"]
+__all__ = [
+    "Response",
+    "EventService",
+    "MAX_BATCH_SIZE",
+    "invalidate_access_key_caches",
+]
 
 logger = logging.getLogger(__name__)
 
 MAX_BATCH_SIZE = 50  # parity: reference rejects batches > 50
+
+#: every live EventService, so in-process key/app deletion (the `pio`
+#: command layer running inside the server process, or tests) can revoke
+#: cached access keys immediately instead of waiting out the TTL.
+#: _LIVE_SERVICES_LOCK guards add vs iterate: WeakSet only defends its
+#: iteration against GC-driven removals, not a concurrent add() from a
+#: server thread constructing a service mid-delete
+_LIVE_SERVICES: "weakref.WeakSet[EventService]" = weakref.WeakSet()
+_LIVE_SERVICES_LOCK = threading.Lock()
+
+
+def invalidate_access_key_caches(keys: Iterable[str] | None = None) -> None:
+    """Drop ``keys`` (or everything, when None) from every live
+    EventService's access-key cache. Called by the accesskey-delete and
+    app-delete command paths; out-of-process servers still revoke within
+    the cache TTL (``PIO_ACCESSKEY_CACHE_SECS`` — docs/eventserver.md)."""
+    key_list = None if keys is None else list(keys)
+    with _LIVE_SERVICES_LOCK:
+        services = list(_LIVE_SERVICES)
+    for service in services:
+        service.invalidate_access_keys(key_list)
 
 
 @dataclasses.dataclass(frozen=True)
 class Response:
     status: int
     body: Any
+    #: extra HTTP headers (e.g. ``Retry-After`` on a 429 from the serving
+    #: runtime's admission control); the transport layer emits them
+    headers: Mapping[str, str] | None = None
 
     def json_bytes(self) -> bytes:
         return json.dumps(self.body, default=str).encode()
@@ -86,6 +116,18 @@ class EventService:
             )
         except ValueError:
             self._key_cache_ttl = 2.0
+        with _LIVE_SERVICES_LOCK:
+            _LIVE_SERVICES.add(self)
+
+    def invalidate_access_keys(self, keys: Iterable[str] | None = None) -> None:
+        """Evict ``keys`` (or all, when None) from the resolved-key cache
+        so a deleted key stops authenticating immediately."""
+        with self._key_cache_lock:
+            if keys is None:
+                self._key_cache.clear()
+            else:
+                for k in keys:
+                    self._key_cache.pop(k, None)
 
     def _resolve_key(self, key: str):
         if self._key_cache_ttl <= 0:
@@ -214,11 +256,26 @@ class EventService:
             valid.append((len(results), event))
             results.append(None)  # filled after the bulk insert
         if valid:
-            ids = Storage.get_l_events().insert_batch(
-                [e for _, e in valid], access_key.appid, channel_id
-            )
-            for (slot, _), eid in zip(valid, ids):
-                results[slot] = {"eventId": eid, "status": 201}
+            try:
+                ids = Storage.get_l_events().insert_batch(
+                    [e for _, e in valid], access_key.appid, channel_id
+                )
+            except Exception:
+                # the route's contract is a per-item status array; a
+                # storage failure maps every pending slot to its own 500
+                # instead of failing the whole request (clients retry by
+                # slot, and already-reported 4xx validation entries
+                # stand). Message stays generic — exception text can
+                # embed backend paths/DSNs (details go to the log)
+                logger.exception("batch event insert failed")
+                for slot, _ in valid:
+                    results[slot] = {
+                        "status": 500,
+                        "message": "Storage error: event was not stored.",
+                    }
+            else:
+                for (slot, _), eid in zip(valid, ids):
+                    results[slot] = {"eventId": eid, "status": 201}
         for item, entry in zip(body, results):
             self._record_stats(access_key.appid, item, entry["status"])
         return Response(200, results)

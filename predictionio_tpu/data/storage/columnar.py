@@ -238,12 +238,14 @@ class _ColumnarEvents(LEvents):
         from collections import OrderedDict
 
         self._seg_cache: "OrderedDict[str, _Segment]" = OrderedDict()
-        #: per-path event-id arrays for point lookups: None = positional
-        #: segment (cached indefinitely — a few bytes), ndarray =
-        #: explicit-id segment (LRU-bounded; ids of a huge segment are
-        #: tens of MB). Segments are immutable, so entries never go
-        #: stale; remove() drops them with the stream.
-        self._ids_cache: "OrderedDict[str, np.ndarray | None]" = OrderedDict()
+        #: per-path point-lookup indexes: None = positional segment
+        #: (cached indefinitely — a few bytes), (sorted ids, argsort
+        #: rows) = explicit-id segment (LRU-bounded; a huge segment's
+        #: index is tens of MB). Segments are immutable, so entries never
+        #: go stale; remove() drops them with the stream.
+        self._ids_cache: "OrderedDict[str, tuple[np.ndarray, np.ndarray] | None]" = (
+            OrderedDict()
+        )
         self._cache_segments = (
             self._CACHE_SEGMENTS if cache_segments is None else cache_segments
         )
@@ -561,21 +563,29 @@ class _ColumnarEvents(LEvents):
                 row = int(row_s)
                 if row < len(seg) and seg.ids is None:
                     return seg.row_event(row), False
-        # explicit-id (compacted) segments: match by stored id. Only the
-        # ids member is read per file (decoding whole segments for a
-        # point lookup would thrash the LRU cache), positional segments
-        # cache a None marker so repeat misses skip their files, and
-        # loaded ids arrays are LRU-cached
+        # explicit-id (compacted) segments: match by stored id through the
+        # per-segment sorted index — O(log rows) searchsorted per segment
+        # instead of a full O(rows) equality scan per point get()/delete().
+        # Only the ids member is read per file (decoding whole segments
+        # for a point lookup would thrash the LRU cache) and positional
+        # segments cache a None marker so repeat misses skip their files
         for path in self._segment_paths(d):
-            ids = self._segment_ids(path)
-            if ids is None:
+            index = self._segment_id_index(path)
+            if index is None:
                 continue
-            hits = np.flatnonzero(ids == event_id)
-            if hits.size:
-                return self._segment(path).row_event(int(hits[0])), False
+            sorted_ids, order = index
+            pos = int(np.searchsorted(sorted_ids, event_id))
+            if pos < sorted_ids.size and sorted_ids[pos] == event_id:
+                return self._segment(path).row_event(int(order[pos])), False
         return None, False
 
-    def _segment_ids(self, path: str) -> np.ndarray | None:
+    def _segment_id_index(
+        self, path: str
+    ) -> tuple[np.ndarray, np.ndarray] | None:
+        """Point-lookup index of one explicit-id segment — ``(ids sorted,
+        argsort rows)`` — or None for positional segments. Built once per
+        segment (O(rows log rows)), LRU-cached; each lookup is then a
+        binary search instead of scanning every id in the store."""
         with self._lock:
             if path in self._ids_cache:
                 self._ids_cache.move_to_end(path)
@@ -586,14 +596,19 @@ class _ColumnarEvents(LEvents):
         else:
             with np.load(path, allow_pickle=False) as z:
                 ids = z["ids"] if "ids" in z.files else None
+        if ids is None:
+            index = None
+        else:
+            order = np.argsort(ids, kind="stable")
+            index = (ids[order], order)
         with self._lock:
-            self._ids_cache[path] = ids
-            # None markers are tiny; only bound the real arrays
+            self._ids_cache[path] = index
+            # None markers are tiny; only bound the real indexes
             real = [k for k, v in self._ids_cache.items() if v is not None]
             while len(real) > max(self._cache_segments, 1):
                 victim = real.pop(0)
                 del self._ids_cache[victim]
-        return ids
+        return index
 
     def _is_dead(self, event_id: str, in_tail: bool, d: str) -> bool:
         tail_ids, seg_rows = self._split_tombstones(self._tombstones(d))

@@ -25,9 +25,15 @@ L2-normalized so logits are bounded by ``inv_temp`` (~10), and
 ``exp(10) * 8192`` sits comfortably inside fp32 — the max subtraction
 would cost an extra pass for nothing.
 
-HBM traffic per step collapses to O(B*D); useful FLOPs stay ~8*B^2*D, so
-the step turns compute-bound — the condition MFU needs. GEMM operands are
-cast to bf16 (fp32 accumulation), riding the MXU at full rate.
+HBM traffic per step collapses to O(B*D). FLOP accounting (matching the
+MFU math in ``bench.py``): **useful** work is 6*B^2*D per step — the
+forward logits GEMM (2*B^2*D) plus the two backward grad GEMMs
+(4*B^2*D); the backward tile recompute adds 2*B^2*D of
+**rematerialization** overhead that buys the HBM savings and is
+deliberately excluded from the MFU numerator. Total executed is
+8*B^2*D, so the step turns compute-bound — the condition MFU needs.
+GEMM operands are cast to bf16 (fp32 accumulation), riding the MXU at
+full rate.
 
 No reference counterpart (the reference has no deep-retrieval template);
 design per /opt/skills/guides/pallas_guide.md.

@@ -1,0 +1,20 @@
+"""Serving runtime — cross-request dynamic micro-batching.
+
+Sits between the HTTP transport and :class:`QueryService`: concurrent
+``POST /queries.json`` requests are coalesced into one
+``handle_batch`` call (one device dispatch per batch instead of one per
+request). See :mod:`predictionio_tpu.serving.batcher`.
+
+This package must stay importable without jax: the batcher is pure
+threading/queue machinery, and tier-1 CI (JAX_PLATFORMS=cpu) guards
+that no accelerator dependency creeps in
+(``tests/test_ci_guards.py::test_serving_runtime_is_accelerator_free``).
+"""
+
+from predictionio_tpu.serving.batcher import (
+    AdmissionPolicy,
+    BatcherConfig,
+    MicroBatcher,
+)
+
+__all__ = ["AdmissionPolicy", "BatcherConfig", "MicroBatcher"]

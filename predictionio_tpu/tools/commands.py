@@ -98,6 +98,8 @@ def app_show(name: str, out: Out = _print) -> dict:
 
 def app_delete(name: str, out: Out = _print) -> None:
     """``pio app delete`` — drop the app, its keys, channels, events."""
+    from predictionio_tpu.api.service import invalidate_access_key_caches
+
     app = Storage.get_meta_data_apps().get_by_name(name)
     if app is None:
         raise StorageError(f"App '{name}' does not exist.")
@@ -106,9 +108,14 @@ def app_delete(name: str, out: Out = _print) -> None:
         le.remove(app.id, ch.id)
         Storage.get_meta_data_channels().delete(ch.id)
     le.remove(app.id)
+    deleted_keys = []
     for k in Storage.get_meta_data_access_keys().get_by_appid(app.id):
         Storage.get_meta_data_access_keys().delete(k.key)
+        deleted_keys.append(k.key)
     Storage.get_meta_data_apps().delete(app.id)
+    # revoke in any event server sharing this process; out-of-process
+    # servers converge within the key-cache TTL (docs/eventserver.md)
+    invalidate_access_key_caches(deleted_keys)
     out(f"Deleted app {name}.")
 
 
@@ -220,8 +227,11 @@ def accesskey_list(app_name: str | None = None, out: Out = _print) -> list[Acces
 
 
 def accesskey_delete(key: str, out: Out = _print) -> None:
+    from predictionio_tpu.api.service import invalidate_access_key_caches
+
     if not Storage.get_meta_data_access_keys().delete(key):
         raise StorageError(f"Access key '{key}' does not exist.")
+    invalidate_access_key_caches([key])
     out(f"Deleted access key {key}.")
 
 

@@ -130,6 +130,44 @@ def build_parser() -> argparse.ArgumentParser:
     deploy.add_argument("--event-server-ip", default="127.0.0.1")
     deploy.add_argument("--event-server-port", type=int, default=7070)
     deploy.add_argument("--accesskey", default="")
+    # ---- cross-request micro-batching (predictionio_tpu.serving)
+    deploy.add_argument(
+        "--batching", action="store_true",
+        help="coalesce concurrent /queries.json requests into batched "
+        "device dispatches (docs/serving.md)",
+    )
+    deploy.add_argument(
+        "--max-batch-size", type=int, default=32,
+        help="most queries per batched dispatch (default 32)",
+    )
+    deploy.add_argument(
+        "--max-batch-delay-ms", type=float, default=2.0,
+        help="longest wait for batchmates past the oldest queued request; "
+        "0 = dispatch immediately, batch only what is already queued",
+    )
+    deploy.add_argument(
+        "--batch-queue", type=int, default=256,
+        help="bounded admission queue size (default 256)",
+    )
+    deploy.add_argument(
+        "--admission-policy", choices=("reject", "block"), default="reject",
+        help="full queue behavior: reject = 429 + Retry-After (default), "
+        "block = wait up to --admission-timeout-ms, then 503",
+    )
+    deploy.add_argument(
+        "--admission-timeout-ms", type=float, default=1000.0,
+        help="block policy only: longest wait for a queue slot",
+    )
+    deploy.add_argument(
+        "--batch-buckets", default="",
+        help="comma-separated batch sizes to pad to (default: powers of "
+        "two up to --max-batch-size); each bucket is one jit shape",
+    )
+    deploy.add_argument(
+        "--batch-warmup-query", default=None, metavar="JSON",
+        help="sample query body; every bucket shape is pre-compiled with "
+        "it at startup so live traffic never recompiles",
+    )
     add_ssl_flags(deploy)
 
     # ---- undeploy
@@ -380,6 +418,7 @@ def main(argv: list[str] | None = None) -> int:
             print(f"Training completed. Engine instance: {instance.id}")
         elif cmd == "deploy":
             from predictionio_tpu.api.http import serve
+            from predictionio_tpu.serving import BatcherConfig
             from predictionio_tpu.workflow import load_engine_variant
             from predictionio_tpu.workflow.serving import FeedbackConfig, QueryService
 
@@ -392,8 +431,26 @@ def main(argv: list[str] | None = None) -> int:
                     ),
                     access_key=args.accesskey,
                 )
+            batching = None
+            if args.batching:
+                batching = BatcherConfig(
+                    max_batch_size=args.max_batch_size,
+                    max_batch_delay_ms=args.max_batch_delay_ms,
+                    max_queue=args.batch_queue,
+                    admission=args.admission_policy,
+                    block_timeout_ms=args.admission_timeout_ms,
+                    buckets=tuple(
+                        int(x) for x in args.batch_buckets.split(",") if x.strip()
+                    ),
+                    warmup_body=(
+                        json.loads(args.batch_warmup_query)
+                        if args.batch_warmup_query
+                        else None
+                    ),
+                )
             service = QueryService(
-                variant, feedback=feedback, instance_id=args.engine_instance_id
+                variant, feedback=feedback, instance_id=args.engine_instance_id,
+                batching=batching,
             )
 
             def wire_stop(server):

@@ -26,6 +26,7 @@ from predictionio_tpu.controller.context import WorkflowContext, local_context
 from predictionio_tpu.controller.engine import Engine
 from predictionio_tpu.controller.params import params_from_json, params_to_json
 from predictionio_tpu.data.storage import Storage
+from predictionio_tpu.serving import BatcherConfig, MicroBatcher
 from predictionio_tpu.workflow.engine_json import EngineVariant
 
 __all__ = [
@@ -93,6 +94,7 @@ class QueryService:
         plugins: Sequence[EngineServerPlugin] = (),
         feedback: FeedbackConfig | None = None,
         instance_id: str | None = None,
+        batching: BatcherConfig | None = None,
     ):
         self.variant = variant
         self.ctx = ctx or local_context()
@@ -122,6 +124,15 @@ class QueryService:
             self._feedback_queue = queue.Queue(maxsize=10_000)
             threading.Thread(target=self._feedback_worker, daemon=True).start()
         self.reload()
+        # cross-request micro-batching (predictionio_tpu.serving): when
+        # enabled, /queries.json routes through the batcher so concurrent
+        # requests share one handle_batch dispatch. Created AFTER reload()
+        # so a warmup_body compiles against the loaded models.
+        self.batcher: MicroBatcher | None = (
+            MicroBatcher(self.handle_batch, batching)
+            if batching is not None
+            else None
+        )
         for p in self.plugins:
             p.start(self)
 
@@ -240,7 +251,9 @@ class QueryService:
             self.query_count += 1
         return 200, payload
 
-    def handle_batch(self, bodies: Sequence[Any]) -> list[tuple[int, Any]]:
+    def handle_batch(
+        self, bodies: Sequence[Any], n_real: int | None = None
+    ) -> list[tuple[int, Any]]:
         """Batch-amortized :meth:`handle_query` (ref
         ``core/workflow/BatchPredict.scala``): bind + supplement each query,
         then push ALL of them through each algorithm's ``batch_predict_base``
@@ -250,7 +263,13 @@ class QueryService:
         predict/serve raises gets its own 500 (the bulk path falls back to
         per-query prediction if the batched call itself raises); the batch
         never aborts. Returns ``[(status, payload), ...]`` aligned with
-        input."""
+        input.
+
+        ``n_real``: when set, slots >= ``n_real`` are bucket-padding added
+        by the micro-batcher — they participate in the batched predict
+        call (shape stability is their whole purpose) but skip the
+        serve/plugin/feedback tail, don't count as queries, and answer
+        ``(200, None)``; the batcher discards them."""
         with self._lock:
             serving = self._serving
             pairs = list(self._algo_model_pairs)
@@ -293,8 +312,12 @@ class QueryService:
                         ]
                     except Exception as e:
                         out[i] = (500, {"message": str(e)})
+        limit = len(bodies) if n_real is None else n_real
         for i, query in queries:
             if out[i] is not None:  # per-query fallback already failed it
+                continue
+            if i >= limit:  # padding slot: no serve tail, no side effects
+                out[i] = (200, None)
                 continue
             try:
                 out[i] = self._finish_query(serving, bodies[i], query, by_slot[i])
@@ -385,10 +408,31 @@ class QueryService:
             "startTime": self.start_time.isoformat(),
             "queryCount": self.query_count,
             "feedbackDropped": self.feedback_dropped,
+            "batching": self.batcher is not None,
             "plugins": [
                 {"name": p.name, "type": p.plugin_type} for p in self.plugins
             ],
         }
+
+    def stats_json(self) -> dict:
+        """``GET /stats.json`` payload: query counters plus, when the
+        micro-batcher is on, its full gauge/latency decomposition."""
+        with self._lock:
+            count = self.query_count
+        out: dict = {
+            "queryCount": count,
+            "startTime": self.start_time.isoformat(),
+            "batching": self.batcher is not None,
+        }
+        if self.batcher is not None:
+            out["batcher"] = self.batcher.stats.to_json()
+        return out
+
+    def close(self) -> None:
+        """Release background resources (the batcher's dispatcher thread).
+        Safe to call more than once; queued requests get a 503."""
+        if self.batcher is not None:
+            self.batcher.close()
 
     # ------------------------------------------------------------ dispatch
     def dispatch(
@@ -406,8 +450,28 @@ class QueryService:
         if path == "/" and method == "GET":
             return Response(200, self.status_json())
         if path == "/queries.json" and method == "POST":
+            if self.batcher is not None:
+                status, payload = self.batcher.submit(body)
+                # admission control: tell well-behaved clients when to
+                # come back instead of letting them hot-loop. The value
+                # is computed once, by the batcher, into the payload
+                if (
+                    status in (429, 503)
+                    and isinstance(payload, Mapping)
+                    and "retryAfterSeconds" in payload
+                ):
+                    return Response(
+                        status,
+                        payload,
+                        headers={
+                            "Retry-After": str(payload["retryAfterSeconds"])
+                        },
+                    )
+                return Response(status, payload)
             status, payload = self.handle_query(body)
             return Response(status, payload)
+        if path == "/stats.json" and method == "GET":
+            return Response(200, self.stats_json())
         if path == "/reload" and method == "POST":
             try:
                 self.reload()

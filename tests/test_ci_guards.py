@@ -55,6 +55,49 @@ def test_every_tracked_python_file_parses():
     assert "__graft_entry__.py" in tracked
 
 
+def test_serving_runtime_is_accelerator_free():
+    """The micro-batching serving runtime (predictionio_tpu/serving/) is
+    host-side orchestration and must run under JAX_PLATFORMS=cpu without
+    ever touching an accelerator: no module in the package may import
+    jax (the device work stays behind QueryService.handle_batch, which
+    the engines gate themselves). An ast walk catches both top-level and
+    function-local imports."""
+    pkg = os.path.join(REPO, "predictionio_tpu", "serving")
+    offenders = []
+    for name in sorted(os.listdir(pkg)):
+        if not name.endswith(".py"):
+            continue
+        with open(os.path.join(pkg, name), "rb") as fh:
+            tree = ast.parse(fh.read(), filename=name)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.name == "jax" or alias.name.startswith("jax."):
+                        offenders.append(f"{name}:{node.lineno}")
+            elif isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                if mod == "jax" or mod.startswith("jax."):
+                    offenders.append(f"{name}:{node.lineno}")
+    assert not offenders, f"serving runtime imports jax: {offenders}"
+
+
+def test_batching_defaults_leave_single_request_path_alone():
+    """Tier-1 latency tests run against the per-request path: batching is
+    strictly opt-in (QueryService default None -> no batcher thread), and
+    when enabled the default config must keep a lone request's added
+    latency to a couple of milliseconds."""
+    import inspect
+
+    from predictionio_tpu.serving import BatcherConfig
+    from predictionio_tpu.workflow.serving import QueryService
+
+    sig = inspect.signature(QueryService.__init__)
+    assert sig.parameters["batching"].default is None
+    cfg = BatcherConfig()
+    assert cfg.max_batch_delay_ms <= 5.0
+    assert cfg.warmup_body is None  # no surprise traffic at construction
+
+
 def test_bench_smoke_runs_green():
     """Execute the real bench in --smoke mode (tiny shapes, CPU, <60 s
     budget) and validate its one-line JSON contract."""
@@ -99,3 +142,17 @@ def test_bench_smoke_runs_green():
     for sub in ("host_path", "device_path"):
         assert "error" not in bp[sub], f"batchpredict {sub} errored: {bp[sub]}"
         assert bp[sub]["queries_per_sec"] > 0
+    # the concurrent-serving section (micro-batcher vs per-request
+    # baseline) must run end-to-end on CPU; throughput superiority is a
+    # property of the real bench environment, not asserted here
+    conc = detail.get("serving_concurrent")
+    assert conc is not None, "missing bench section 'serving_concurrent'"
+    assert "error" not in conc, f"serving_concurrent errored: {conc}"
+    assert conc["concurrency"] >= 32
+    assert conc["per_request_baseline"]["queries_per_sec"] > 0
+    assert conc["micro_batched"]["queries_per_sec"] > 0
+    assert conc["per_request_baseline"]["errors"] == 0
+    assert conc["micro_batched"]["errors"] == 0
+    batcher = conc["micro_batched"]["batcher"]
+    assert batcher["mean_batch_size"] >= 1.0
+    assert batcher["bucket_misses_after_warmup"] == 0

@@ -133,6 +133,65 @@ class TestEventRoutes:
             == 400
         )
 
+    def test_batch_storage_failure_keeps_per_item_contract(
+        self, service_env, monkeypatch
+    ):
+        """A storage failure during the bulk insert must not turn the
+        whole request into a 500: every pending slot gets its own 500
+        entry, and per-item validation results already recorded stand."""
+        from predictionio_tpu.data.storage import Storage
+
+        _, _, key = service_env
+        svc = EventService()
+        events_store = Storage.get_l_events()
+
+        def boom(*a, **k):
+            raise RuntimeError("disk on fire")
+
+        monkeypatch.setattr(type(events_store), "insert_batch", boom)
+        batch = [EV, dict(EV, event="$badname"), dict(EV, entityId="u9")]
+        r = svc.dispatch("POST", "/batch/events.json", {"accessKey": key}, batch)
+        assert r.status == 200
+        statuses = [item["status"] for item in r.body]
+        assert statuses == [500, 400, 500]
+        # generic message only — exception text may leak storage internals
+        assert "disk on fire" not in r.body[0]["message"]
+        assert "Storage error" in r.body[0]["message"]
+
+    def test_accesskey_delete_invalidates_live_caches(
+        self, service_env, monkeypatch
+    ):
+        """In-process `pio accesskey delete` / `pio app delete` revoke
+        cached keys immediately (satellite of ISSUE 1; out-of-process
+        servers converge within PIO_ACCESSKEY_CACHE_SECS)."""
+        from predictionio_tpu.tools import commands
+
+        Storage, app_id, key = service_env
+        monkeypatch.setenv("PIO_ACCESSKEY_CACHE_SECS", "3600")
+        svc = EventService()  # effectively-permanent cache
+        assert svc.dispatch("POST", "/events.json", {"accessKey": key}, EV).status == 201
+        commands.accesskey_delete(key, out=lambda *_: None)
+        r = svc.dispatch("POST", "/events.json", {"accessKey": key}, EV)
+        assert r.status == 401  # without invalidation the stale key still wins
+
+    def test_app_delete_invalidates_live_caches(
+        self, memory_storage_env, monkeypatch
+    ):
+        from predictionio_tpu.data.storage.base import AccessKey, App
+        from predictionio_tpu.tools import commands
+
+        Storage = memory_storage_env
+        app_id = Storage.get_meta_data_apps().insert(App(id=0, name="doomed"))
+        key = Storage.get_meta_data_access_keys().insert(
+            AccessKey(key="", appid=app_id)
+        )
+        Storage.get_l_events().init(app_id)
+        monkeypatch.setenv("PIO_ACCESSKEY_CACHE_SECS", "3600")
+        svc = EventService()
+        assert svc.dispatch("POST", "/events.json", {"accessKey": key}, EV).status == 201
+        commands.app_delete("doomed", out=lambda *_: None)
+        assert svc.dispatch("POST", "/events.json", {"accessKey": key}, EV).status == 401
+
     def test_stats(self, service_env):
         _, _, key = service_env
         svc = EventService(stats=True)

@@ -1,0 +1,487 @@
+"""Micro-batching serving runtime (predictionio_tpu.serving).
+
+Covers the ISSUE-1 acceptance surface: concurrent clients get correct,
+request-matched responses through the batcher (including a poisoned
+query that fails alone), a lone request is served within about
+``max_batch_delay_ms``, the bounded queue's reject policy produces 429 +
+``Retry-After`` (and the block policy 503), bucket padding keeps
+dispatch shapes inside the warmed set, and the stats endpoint exposes
+the latency decomposition.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from predictionio_tpu.api.stats import ServingStats
+from predictionio_tpu.api.http import start_background
+from predictionio_tpu.controller import local_context
+from predictionio_tpu.serving import AdmissionPolicy, BatcherConfig, MicroBatcher
+from predictionio_tpu.workflow import load_engine_variant, run_train
+from predictionio_tpu.workflow.serving import QueryService
+
+VARIANT = {
+    "id": "batched-engine",
+    "version": "0.1",
+    "engineFactory": "fake_dase:engine0",
+    "datasource": {"params": {"base": 10}},
+    "algorithms": [
+        {"name": "a0", "params": {"mult": 2}},
+        {"name": "a1", "params": {"mult": 3}},
+    ],
+}
+# fake_dase engine0: models 22 and 33, ServingSum -> query q answers 2q+55
+
+
+@pytest.fixture()
+def trained(memory_storage_env):
+    variant = load_engine_variant(VARIANT)
+    run_train(variant, local_context())
+    return variant
+
+
+def _echo_batch(bodies):
+    """Stand-in handler: status 200, payload echoes the body."""
+    return [(200, {"echo": b}) for b in bodies]
+
+
+class TestConfig:
+    def test_default_buckets_are_powers_of_two(self):
+        assert BatcherConfig(max_batch_size=32).bucket_sizes() == (
+            1, 2, 4, 8, 16, 32,
+        )
+        # non-power-of-two max is always its own (largest) bucket
+        assert BatcherConfig(max_batch_size=48).bucket_sizes() == (
+            1, 2, 4, 8, 16, 32, 48,
+        )
+
+    def test_explicit_buckets_sorted_and_capped(self):
+        cfg = BatcherConfig(max_batch_size=16, buckets=(8, 4))
+        # largest bucket must fit a full batch
+        assert cfg.bucket_sizes() == (4, 8, 16)
+        # oversized buckets would only inflate padding: dropped
+        assert BatcherConfig(max_batch_size=32, buckets=(4, 64)).bucket_sizes() == (
+            4, 32,
+        )
+        assert BatcherConfig(max_batch_size=8, buckets=(64,)).bucket_sizes() == (8,)
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            BatcherConfig(max_batch_size=0)
+        with pytest.raises(ValueError):
+            BatcherConfig(max_batch_delay_ms=-1)
+        with pytest.raises(ValueError):
+            BatcherConfig(admission="teapot")
+        # CLI strings coerce to the enum
+        assert BatcherConfig(admission="block").admission is AdmissionPolicy.BLOCK
+
+
+class TestBatcherCore:
+    def test_lone_request_served_within_delay(self):
+        delay_ms = 50.0
+        b = MicroBatcher(
+            _echo_batch,
+            BatcherConfig(max_batch_size=8, max_batch_delay_ms=delay_ms),
+        )
+        try:
+            t0 = time.monotonic()
+            status, payload = b.submit({"q": 1})
+            elapsed = time.monotonic() - t0
+            assert status == 200 and payload == {"echo": {"q": 1}}
+            # must wait out the batch window but not much more (generous
+            # upper bound for slow CI hosts)
+            assert elapsed < 1.0
+        finally:
+            b.close()
+
+    def test_zero_delay_dispatches_immediately(self):
+        b = MicroBatcher(
+            _echo_batch, BatcherConfig(max_batch_size=8, max_batch_delay_ms=0.0)
+        )
+        try:
+            t0 = time.monotonic()
+            status, _ = b.submit({"q": 2})
+            assert status == 200
+            assert time.monotonic() - t0 < 0.5
+        finally:
+            b.close()
+
+    def test_batches_are_padded_to_buckets(self):
+        sizes = []
+        gate = threading.Event()
+
+        def handler(bodies):
+            sizes.append(len(bodies))
+            if len(sizes) == 1:  # hold the FIRST batch so the rest queue up
+                gate.wait(timeout=5)
+            return _echo_batch(bodies)
+
+        b = MicroBatcher(
+            handler, BatcherConfig(max_batch_size=8, max_batch_delay_ms=5.0)
+        )
+        try:
+            # sacrificial request occupies the dispatcher...
+            warm = threading.Thread(target=b.submit, args=({"q": "warm"},))
+            warm.start()
+            for _ in range(400):
+                if sizes:
+                    break
+                time.sleep(0.005)
+            # ...so these three all sit in the queue together
+            threads = [
+                threading.Thread(target=b.submit, args=({"q": i},))
+                for i in range(3)
+            ]
+            for t in threads:
+                t.start()
+            for _ in range(400):
+                if b._queue.qsize() == 3:
+                    break
+                time.sleep(0.005)
+            gate.set()
+            warm.join(timeout=5)
+            for t in threads:
+                t.join(timeout=5)
+            # batch of 1 (bucket 1), then the 3 queued padded to bucket 4
+            assert sizes == [1, 4]
+            s = b.stats.to_json()
+            assert s["batchedQueries"] == 4
+            assert s["bucketHist"] == {"1": 1, "4": 1}
+            assert s["paddingOverhead"] > 0
+        finally:
+            b.close()
+
+    def test_warmup_precompiles_every_bucket(self):
+        seen = []
+
+        def handler(bodies):
+            seen.append(len(bodies))
+            return _echo_batch(bodies)
+
+        b = MicroBatcher(
+            handler,
+            BatcherConfig(
+                max_batch_size=4, max_batch_delay_ms=0.0, warmup_body={"w": 1}
+            ),
+        )
+        try:
+            assert sorted(seen) == [1, 2, 4]  # every bucket, once
+            assert sorted(b.stats.warmed_buckets) == [1, 2, 4]
+            b.submit({"q": 1})
+            # live traffic landed in an already-warm bucket: no miss
+            assert b.stats.to_json()["bucketMisses"] == 0
+        finally:
+            b.close()
+
+    def test_reject_policy_returns_429(self):
+        release = threading.Event()
+
+        def slow(bodies):
+            release.wait(timeout=10)
+            return _echo_batch(bodies)
+
+        b = MicroBatcher(
+            slow,
+            BatcherConfig(
+                max_batch_size=1, max_batch_delay_ms=0.0, max_queue=1,
+                admission="reject",
+            ),
+        )
+        try:
+            results: list[tuple[int, dict]] = []
+            threads = [
+                threading.Thread(
+                    target=lambda: results.append(b.submit({"q": 0}))
+                )
+                for _ in range(6)
+            ]
+            for t in threads:
+                t.start()
+            # wait until overload is observable, then release the handler
+            for _ in range(400):
+                if b.stats.rejected:
+                    break
+                time.sleep(0.005)
+            release.set()
+            for t in threads:
+                t.join(timeout=10)
+            statuses = sorted(s for s, _ in results)
+            assert 429 in statuses, statuses
+            assert statuses.count(200) >= 1
+            rejected = next(p for s, p in results if s == 429)
+            assert rejected["retryAfterSeconds"] >= 1
+            assert b.stats.to_json()["rejected"] >= 1
+        finally:
+            b.close()
+
+    def test_block_policy_times_out_with_503(self):
+        release = threading.Event()
+
+        def slow(bodies):
+            release.wait(timeout=10)
+            return _echo_batch(bodies)
+
+        b = MicroBatcher(
+            slow,
+            BatcherConfig(
+                max_batch_size=1, max_batch_delay_ms=0.0, max_queue=1,
+                admission="block", block_timeout_ms=50.0,
+            ),
+        )
+        try:
+            results: list[tuple[int, dict]] = []
+            threads = [
+                threading.Thread(
+                    target=lambda: results.append(b.submit({"q": 0}))
+                )
+                for _ in range(6)
+            ]
+            for t in threads:
+                t.start()
+            for _ in range(400):
+                if b.stats.block_timeouts:
+                    break
+                time.sleep(0.005)
+            release.set()
+            for t in threads:
+                t.join(timeout=10)
+            assert any(s == 503 for s, _ in results)
+            assert b.stats.to_json()["blockTimeouts"] >= 1
+        finally:
+            b.close()
+
+    def test_handler_crash_answers_everyone(self):
+        def broken(bodies):
+            raise RuntimeError("kaboom")
+
+        b = MicroBatcher(
+            broken, BatcherConfig(max_batch_size=4, max_batch_delay_ms=0.0)
+        )
+        try:
+            status, payload = b.submit({"q": 1})
+            # everyone answered, but exception text stays out of responses
+            assert status == 500 and "kaboom" not in payload["message"]
+            assert "Batch dispatch failed" in payload["message"]
+        finally:
+            b.close()
+
+    def test_close_answers_queued_requests(self):
+        release = threading.Event()
+
+        def slow(bodies):
+            release.wait(timeout=10)
+            return _echo_batch(bodies)
+
+        b = MicroBatcher(
+            slow,
+            BatcherConfig(max_batch_size=1, max_batch_delay_ms=0.0, max_queue=4),
+        )
+        results: list[tuple[int, dict]] = []
+        threads = [
+            threading.Thread(target=lambda: results.append(b.submit({"q": 0})))
+            for _ in range(3)
+        ]
+        for t in threads:
+            t.start()
+        time.sleep(0.05)
+        b._closed = True  # stop the loop at the next wake
+        release.set()
+        b.close()
+        for t in threads:
+            t.join(timeout=10)
+        assert len(results) == 3
+        assert all(s in (200, 503) for s, _ in results)
+
+
+class TestQueryServiceIntegration:
+    CFG = dict(max_batch_size=8, max_batch_delay_ms=5.0)
+
+    def test_concurrent_clients_get_matched_responses(self, trained):
+        """N threads over real HTTP: every client gets ITS answer, and one
+        poisoned query fails alone while its batchmates succeed."""
+        qs = QueryService(trained, batching=BatcherConfig(**self.CFG))
+        server, _ = start_background(qs.dispatch)
+        port = server.server_address[1]
+        n_clients, per_client = 12, 10
+        poison = (3, 4)  # (client, request) that sends a non-numeric body
+        results: dict[tuple[int, int], tuple[int, object]] = {}
+        lock = threading.Lock()
+
+        def client(cid: int):
+            for r in range(per_client):
+                body = b'"bad"' if (cid, r) == poison else str(
+                    cid * 1000 + r
+                ).encode()
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{port}/queries.json",
+                    data=body,
+                    headers={"Content-Type": "application/json"},
+                    method="POST",
+                )
+                try:
+                    with urllib.request.urlopen(req, timeout=30) as resp:
+                        out = (resp.status, json.loads(resp.read()))
+                except urllib.error.HTTPError as e:
+                    out = (e.code, json.loads(e.read()))
+                with lock:
+                    results[(cid, r)] = out
+
+        threads = [
+            threading.Thread(target=client, args=(c,)) for c in range(n_clients)
+        ]
+        try:
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=60)
+            assert len(results) == n_clients * per_client
+            for (cid, r), (status, payload) in results.items():
+                if (cid, r) == poison:
+                    # per-item isolation: only the poisoned query fails
+                    assert status == 500, (status, payload)
+                else:
+                    q = cid * 1000 + r
+                    assert status == 200 and payload == 2 * q + 55, (
+                        (cid, r), status, payload,
+                    )
+            # cross-request batching actually happened
+            s = qs.batcher.stats.to_json()
+            assert s["batches"] < s["batchedQueries"]
+            assert s["meanBatchSize"] > 1.0
+        finally:
+            server.shutdown()
+            server.server_close()
+            qs.close()
+
+    def test_batching_off_by_default(self, trained):
+        qs = QueryService(trained)
+        assert qs.batcher is None
+        assert qs.status_json()["batching"] is False
+        # per-request path still serves and /stats.json still answers
+        assert qs.dispatch("POST", "/queries.json", {}, 7).status == 200
+        r = qs.dispatch("GET", "/stats.json", {})
+        assert r.status == 200 and r.body["batching"] is False
+
+    def test_stats_endpoint_exposes_decomposition(self, trained):
+        qs = QueryService(
+            trained,
+            batching=BatcherConfig(max_batch_size=4, max_batch_delay_ms=0.0),
+        )
+        try:
+            assert qs.status_json()["batching"] is True
+            for q in range(5):
+                status, payload = qs.batcher.submit(q)
+                assert status == 200 and payload == 2 * q + 55
+            body = qs.dispatch("GET", "/stats.json", {}).body
+            assert body["batching"] is True
+            b = body["batcher"]
+            assert b["submitted"] == b["completed"] == 5
+            for phase in ("queueWait", "batchForm", "handle", "total"):
+                assert b["latencyMs"][phase]["p50"] is not None
+            assert b["queueDepth"] == 0 and b["inflightBatch"] == 0
+        finally:
+            qs.close()
+
+    def test_http_429_carries_retry_after_header(self, trained):
+        qs = QueryService(
+            trained,
+            batching=BatcherConfig(
+                max_batch_size=1, max_batch_delay_ms=0.0, max_queue=1
+            ),
+        )
+        release = threading.Event()
+        inner = qs.batcher._handle
+
+        def slow(bodies, **kw):
+            release.wait(timeout=10)
+            return inner(bodies, **kw)
+
+        qs.batcher._handle = slow
+        try:
+            answers = []
+            threads = [
+                threading.Thread(
+                    target=lambda: answers.append(
+                        qs.dispatch("POST", "/queries.json", {}, 1)
+                    )
+                )
+                for _ in range(6)
+            ]
+            for t in threads:
+                t.start()
+            for _ in range(400):
+                if qs.batcher.stats.rejected:
+                    break
+                time.sleep(0.005)
+            release.set()
+            for t in threads:
+                t.join(timeout=10)
+            rejected = [r for r in answers if r.status == 429]
+            assert rejected, [r.status for r in answers]
+            assert int(rejected[0].headers["Retry-After"]) >= 1
+        finally:
+            release.set()
+            qs.close()
+
+    def test_padding_and_warmup_have_no_serve_side_effects(self, trained):
+        """Filler/warm-up queries compile the bucket shapes but must not
+        count as queries or reach plugins (or, in production, feedback)."""
+        from predictionio_tpu.workflow.serving import EngineServerPlugin
+
+        seen = []
+
+        class Sniffer(EngineServerPlugin):
+            name = "sniffer"
+
+            def process(self, query, prediction, service):
+                seen.append(prediction)
+                return prediction
+
+        qs = QueryService(
+            trained,
+            plugins=[Sniffer()],
+            batching=BatcherConfig(
+                max_batch_size=4, max_batch_delay_ms=0.0, warmup_body=0
+            ),
+        )
+        try:
+            # warm-up ran buckets 4+2+1 = 7 filler queries
+            assert qs.query_count == 0 and seen == []
+            status, payload = qs.batcher.submit(10)
+            assert status == 200 and payload == 75
+            assert qs.query_count == 1 and seen == [75]
+        finally:
+            qs.close()
+
+    def test_warmup_body_flows_through_real_engine(self, trained):
+        qs = QueryService(
+            trained,
+            batching=BatcherConfig(
+                max_batch_size=4, max_batch_delay_ms=0.0, warmup_body=0
+            ),
+        )
+        try:
+            assert sorted(qs.batcher.stats.warmed_buckets) == [1, 2, 4]
+            status, payload = qs.batcher.submit(10)
+            assert status == 200 and payload == 75
+            assert qs.batcher.stats.to_json()["bucketMisses"] == 0
+        finally:
+            qs.close()
+
+
+def test_serving_stats_percentiles_empty_and_filled():
+    s = ServingStats(window=8)
+    empty = s.to_json()
+    assert empty["latencyMs"]["total"]["p99"] is None
+    for ms in (1.0, 2.0, 3.0, 100.0):
+        s.record_request(ms)
+    j = s.to_json()
+    assert j["completed"] == 4
+    assert j["latencyMs"]["total"]["p50"] == 2.0
+    assert j["latencyMs"]["total"]["p99"] == 100.0
