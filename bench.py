@@ -911,6 +911,288 @@ def _bench_serving_concurrent(n_clients: int, per_client: int) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# Resilience: recovery time + goodput through an injected storage outage
+# (ISSUE 2 — retries, circuit breaker, health probes, graceful degradation)
+# ---------------------------------------------------------------------------
+
+
+def _bench_resilience(outage_s: float, n_clients: int) -> dict:
+    """Stage a storage outage under concurrent query load and measure
+    what the resilience layer buys: the remote-storage breaker opens
+    (storage calls fail fast instead of stacking timeouts), ``/readyz``
+    flips unready and recovers, a mid-outage ``/reload`` degrades to
+    serving the last-good model (503, never a raw 500), and query
+    goodput holds through the outage because the loaded model needs no
+    storage. Reports recovery time (outage end -> first green
+    ``/readyz``) and goodput inside the outage window."""
+    import http.client
+    import tempfile
+    import threading
+    import urllib.error
+    import urllib.request
+
+    from predictionio_tpu.api.http import start_background
+    from predictionio_tpu.controller import local_context
+    from predictionio_tpu.data.event import DataMap, Event
+    from predictionio_tpu.data.storage import Storage
+    from predictionio_tpu.data.storage import sqlite as sqlite_driver
+    from predictionio_tpu.data.storage.base import App, StorageClientConfig
+    from predictionio_tpu.data.storage.remote import StorageRpcService
+    from predictionio_tpu.resilience import FaultInjector
+    from predictionio_tpu.workflow import load_engine_variant, run_train
+    from predictionio_tpu.workflow.serving import QueryService
+
+    num_users = int(os.environ.get("BENCH_RES_USERS", 500))
+    num_items = int(os.environ.get("BENCH_RES_ITEMS", 2000))
+    n_events = int(os.environ.get("BENCH_RES_EVENTS", 20_000))
+
+    tmp = tempfile.mkdtemp(prefix="bench_resilience_")
+    backing = sqlite_driver.StorageClient(
+        StorageClientConfig("B", "sqlite", {"path": os.path.join(tmp, "b.db")})
+    )
+    inj = FaultInjector()
+    rpc_service = StorageRpcService(client=backing)
+    storage_server, _ = start_background(inj.wrap_dispatch(rpc_service.dispatch))
+    storage_port = storage_server.server_address[1]
+    Storage.configure(
+        {
+            "PIO_FS_BASEDIR": tmp,
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "NET",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "NET",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "NET",
+            "PIO_STORAGE_SOURCES_NET_TYPE": "remote",
+            "PIO_STORAGE_SOURCES_NET_HOSTS": "127.0.0.1",
+            "PIO_STORAGE_SOURCES_NET_PORTS": str(storage_port),
+            # the resilience opt-ins under measurement
+            "PIO_STORAGE_SOURCES_NET_RETRIES": "1",
+            "PIO_STORAGE_SOURCES_NET_RETRY_BASE_DELAY_S": "0.02",
+            "PIO_STORAGE_SOURCES_NET_BREAKER_THRESHOLD": "3",
+            "PIO_STORAGE_SOURCES_NET_BREAKER_RESET_S": "0.5",
+        }
+    )
+    try:
+        app_id = Storage.get_meta_data_apps().insert(App(id=0, name="bench-res"))
+        rng = np.random.default_rng(7)
+        users = rng.integers(0, num_users, n_events)
+        items = rng.integers(0, num_items, n_events)
+        Storage.get_p_events().write(
+            (
+                Event(
+                    event="rate",
+                    entity_type="user",
+                    entity_id=str(u),
+                    target_entity_type="item",
+                    target_entity_id=str(i),
+                    properties=DataMap({"rating": float((u + i) % 5 + 1)}),
+                )
+                for u, i in zip(users, items)
+            ),
+            app_id,
+        )
+        variant = load_engine_variant(
+            {
+                "id": "bench-res",
+                "version": "1",
+                "engineFactory": "predictionio_tpu.templates."
+                "recommendation:engine_factory",
+                "datasource": {"params": {"appName": "bench-res"}},
+                "algorithms": [
+                    {
+                        "name": "als",
+                        "params": {
+                            "rank": 16,
+                            "numIterations": 2,
+                            "lambda": 0.05,
+                            "seed": 7,
+                        },
+                    }
+                ],
+            }
+        )
+        run_train(variant, local_context())
+        qs = QueryService(variant)
+        server, _ = start_background(qs.dispatch)
+        port = server.server_address[1]
+        try:
+            base = f"http://127.0.0.1:{port}"
+
+            def get_json(path: str) -> tuple[int, dict]:
+                try:
+                    with urllib.request.urlopen(base + path, timeout=10) as r:
+                        return r.status, json.loads(r.read())
+                except urllib.error.HTTPError as e:
+                    try:
+                        return e.code, json.loads(e.read())
+                    except Exception:
+                        return e.code, {}
+                except Exception:
+                    # a dropped connection under load must not kill the
+                    # prober thread or abort the section — count it as a
+                    # failed probe and keep measuring
+                    return -1, {}
+
+            stop = threading.Event()
+            t0 = time.perf_counter()
+            samples: list[tuple[float, int]] = []  # (t, status) per query
+            samples_lock = threading.Lock()
+
+            def client(cid: int) -> None:
+                conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+                crng = np.random.default_rng(1000 + cid)
+                body_for = lambda u: json.dumps(  # noqa: E731
+                    {"user": str(int(u)), "num": 5}
+                ).encode()
+                while not stop.is_set():
+                    u = int(crng.integers(0, num_users))
+                    try:
+                        conn.request(
+                            "POST", "/queries.json", body=body_for(u),
+                            headers={"Content-Type": "application/json"},
+                        )
+                        resp = conn.getresponse()
+                        resp.read()
+                        status = resp.status
+                    except Exception:
+                        status = -1
+                        conn.close()
+                        conn = http.client.HTTPConnection(
+                            "127.0.0.1", port, timeout=30
+                        )
+                    with samples_lock:
+                        samples.append((time.perf_counter() - t0, status))
+                conn.close()
+
+            ready_samples: list[tuple[float, bool]] = []
+
+            def prober() -> None:
+                while not stop.is_set():
+                    s, _body = get_json("/readyz")
+                    ready_samples.append((time.perf_counter() - t0, s == 200))
+                    time.sleep(0.025)
+
+            threads = [
+                threading.Thread(target=client, args=(c,))
+                for c in range(n_clients)
+            ] + [threading.Thread(target=prober)]
+            for t in threads:
+                t.start()
+
+            time.sleep(0.75)  # healthy warm-up window
+            outage_begin = time.perf_counter() - t0
+            inj.fail_for(outage_s)
+            time.sleep(outage_s / 2)
+            # mid-outage reload: must degrade (503), never wedge or 500
+            try:
+                urllib.request.urlopen(
+                    urllib.request.Request(
+                        base + "/reload", data=b"{}",
+                        headers={"Content-Type": "application/json"},
+                    ),
+                    timeout=30,
+                )
+                reload_during_outage = 200
+            except urllib.error.HTTPError as e:
+                reload_during_outage = e.code
+            time.sleep(outage_s / 2)
+            # the fault clock expired exactly outage_s after fail_for(),
+            # regardless of how long the degraded reload above took —
+            # windowing on wall time here would count post-outage healthy
+            # traffic as "during outage"
+            outage_end = outage_begin + outage_s
+
+            # recovery: first green /readyz after the outage ends
+            recovery_s = None
+            give_up = time.perf_counter() + 15.0
+            while time.perf_counter() < give_up:
+                s, _body = get_json("/readyz")
+                if s == 200:
+                    recovery_s = (time.perf_counter() - t0) - outage_end
+                    break
+                time.sleep(0.02)
+            time.sleep(0.75)  # healthy tail window
+            stop.set()
+            for t in threads:
+                t.join(timeout=30)
+
+            # post-recovery reload clears the degraded flag
+            urllib.request.urlopen(
+                urllib.request.Request(
+                    base + "/reload", data=b"{}",
+                    headers={"Content-Type": "application/json"},
+                ),
+                timeout=30,
+            )
+            # the quiesced server should answer immediately; a couple of
+            # retries keep one transient connection blip from aborting
+            # the whole section (get_json returns (-1, {}) on errors)
+            for _ in range(3):
+                _s, stats = get_json("/stats.json")
+                if _s == 200:
+                    break
+                time.sleep(0.2)
+            breaker = stats["resilience"]["storage_rpc:NET"]["breaker"]
+
+            def window(lo: float, hi: float) -> list[int]:
+                return [s for (ts, s) in samples if lo <= ts < hi]
+
+            during = window(outage_begin, outage_end)
+            before = window(0.0, outage_begin)
+            wall = max(ts for ts, _ in samples) if samples else 1.0
+            statuses = [s for _, s in samples]
+            went_unready = any(not ok for _, ok in ready_samples)
+            return {
+                "outage_seconds": outage_s,
+                "clients": n_clients,
+                "queries": {
+                    "total": len(samples),
+                    "ok": statuses.count(200),
+                    "raw_500s": statuses.count(500),
+                    "shed_429_503": statuses.count(429) + statuses.count(503),
+                    "transport_errors": statuses.count(-1),
+                },
+                "qps_overall": round(len(samples) / wall, 1),
+                "goodput_during_outage_qps": round(
+                    during.count(200) / max(outage_s, 1e-9), 1
+                ),
+                "goodput_before_outage_qps": round(
+                    before.count(200) / max(outage_begin, 1e-9), 1
+                ),
+                "reload_during_outage_status": reload_during_outage,
+                "readyz": {
+                    "went_unready": went_unready,
+                    "recovery_seconds": (
+                        round(recovery_s, 3) if recovery_s is not None else None
+                    ),
+                },
+                "breaker": {
+                    "opened_count": breaker["openedCount"],
+                    "state_after_recovery": breaker["state"],
+                    "fast_fails": breaker["fastFails"],
+                },
+                "rpc": {
+                    "retries": stats["resilience"]["storage_rpc:NET"]["retries"],
+                    "transport_failures": stats["resilience"]["storage_rpc:NET"][
+                        "transportFailures"
+                    ],
+                },
+                "degraded_after_recovery": stats["degraded"],
+                "note": (
+                    "queries serve from the loaded model during the outage "
+                    "(degraded mode); readiness + breaker reflect storage "
+                    "health; recovery = outage end -> first green /readyz"
+                ),
+            }
+        finally:
+            server.shutdown()
+            server.server_close()
+    finally:
+        Storage.configure(None)
+        storage_server.shutdown()
+        storage_server.server_close()
+        backing.close()
+
+
+# ---------------------------------------------------------------------------
 # Serving latency over real HTTP (p50 target: < 10 ms, BASELINE.md)
 # ---------------------------------------------------------------------------
 
@@ -1150,6 +1432,10 @@ def main() -> None:
         os.environ["BENCH_CONC_EVENTS"] = "4000"
         os.environ["BENCH_CONC_USERS"] = "500"
         os.environ["BENCH_CONC_ITEMS"] = "2000"
+        os.environ["BENCH_RESILIENCE"] = "1"
+        os.environ["BENCH_RES_OUTAGE_S"] = "2.0"
+        os.environ["BENCH_RES_CLIENTS"] = "4"
+        os.environ["BENCH_RES_EVENTS"] = "3000"
         os.environ.pop("BENCH_PRECISION_COMPARE", None)
         # fresh compile cache: a persistent cache populated on a different
         # host can carry AOT results whose CPU features mismatch (SIGILL risk)
@@ -1246,6 +1532,14 @@ def main() -> None:
             detail["batchpredict"] = _bench_batchpredict(on_accel)
         except Exception as e:
             detail["batchpredict"] = {"error": str(e)[:300]}
+
+    if os.environ.get("BENCH_RESILIENCE", "1") != "0":
+        outage_s = float(os.environ.get("BENCH_RES_OUTAGE_S", 2.0))
+        res_clients = int(os.environ.get("BENCH_RES_CLIENTS", 8))
+        try:
+            detail["resilience"] = _bench_resilience(outage_s, res_clients)
+        except Exception as e:
+            detail["resilience"] = {"error": str(e)[:300]}
 
     print(
         json.dumps(

@@ -62,8 +62,26 @@ def ssl_context_from_env() -> ssl.SSLContext | None:
 #: signature shared with EventService.dispatch / QueryService.dispatch
 Dispatcher = Callable[..., "object"]
 
+#: readiness hook: () -> {"ready": bool, "checks": {...}} — served at
+#: GET /readyz (see _make_handler)
+ReadinessHook = Callable[[], Mapping]
 
-def _make_handler(dispatch: Dispatcher):
+
+def _resolve_readiness(
+    dispatch: Dispatcher, readiness: ReadinessHook | None
+) -> ReadinessHook | None:
+    """An explicit hook wins; otherwise a service object's ``readiness``
+    method is discovered from a bound ``dispatch`` — so every framework
+    server (event/query/admin/dashboard/storage) gets ``/readyz`` for
+    free the moment its service class defines one."""
+    if readiness is not None:
+        return readiness
+    owner = getattr(dispatch, "__self__", None)
+    hook = getattr(owner, "readiness", None)
+    return hook if callable(hook) else None
+
+
+def _make_handler(dispatch: Dispatcher, readiness: ReadinessHook | None = None):
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
         #: per-connection socket timeout — bounds stalled clients (incl.
@@ -83,6 +101,16 @@ def _make_handler(dispatch: Dispatcher):
 
         def _respond(self):
             parsed = urllib.parse.urlparse(self.path)
+            # health probes are transport-level (docs/operations.md):
+            # answered before service dispatch so every server exposes
+            # them uniformly and a wedged service layer cannot take the
+            # liveness probe down with it
+            if self.command == "GET" and parsed.path == "/healthz":
+                self._send(200, b'{"status": "ok"}')
+                return
+            if self.command == "GET" and parsed.path == "/readyz":
+                self._ready_probe()
+                return
             params = {
                 k: v[0] for k, v in urllib.parse.parse_qs(parsed.query).items()
             }
@@ -127,6 +155,21 @@ def _make_handler(dispatch: Dispatcher):
                 getattr(resp, "headers", None),
             )
 
+        def _ready_probe(self):
+            """GET /readyz: 200 when the service's readiness hook says
+            every dependency check passed, 503 otherwise. Servers without
+            a hook are ready whenever they are alive."""
+            if readiness is None:
+                self._send(200, b'{"ready": true, "checks": {}}')
+                return
+            try:
+                report = dict(readiness())
+            except Exception as e:
+                logger.exception("readiness hook failed")
+                report = {"ready": False, "error": str(e)[:200]}
+            status = 200 if report.get("ready") else 503
+            self._send(status, json.dumps(report, default=str).encode())
+
         def _send(
             self,
             status: int,
@@ -161,8 +204,10 @@ def _make_server(
     host: str,
     port: int,
     ssl_context: ssl.SSLContext | None,
+    readiness: ReadinessHook | None = None,
 ) -> ThreadingHTTPServer:
-    server = _Server((host, port), _make_handler(dispatch))
+    handler = _make_handler(dispatch, _resolve_readiness(dispatch, readiness))
+    server = _Server((host, port), handler)
     if ssl_context is not None:
         # defer the handshake to the per-connection worker thread: with
         # do_handshake_on_connect=True it would run inside accept() on
@@ -181,12 +226,15 @@ def serve(
     port: int = 7070,
     ssl_context: ssl.SSLContext | None = None,
     ready_callback: Callable[[ThreadingHTTPServer], None] | None = None,
+    readiness: ReadinessHook | None = None,
 ) -> None:
     """Blocking serve-forever (used by ``pio eventserver`` / ``pio deploy``).
 
     ``ready_callback`` receives the bound server before requests flow —
-    deploy uses it to wire the ``GET /stop`` shutdown hook."""
-    server = _make_server(dispatch, host, port, ssl_context)
+    deploy uses it to wire the ``GET /stop`` shutdown hook. ``readiness``
+    backs ``GET /readyz`` (defaults to the service's own ``readiness``
+    method when ``dispatch`` is a bound method)."""
+    server = _make_server(dispatch, host, port, ssl_context, readiness)
     logger.info(
         "Listening on %s://%s:%d",
         "https" if ssl_context else "http", host, port,
@@ -204,11 +252,12 @@ def start_background(
     host: str = "127.0.0.1",
     port: int = 0,
     ssl_context: ssl.SSLContext | None = None,
+    readiness: ReadinessHook | None = None,
 ) -> tuple[ThreadingHTTPServer, threading.Thread]:
     """Start on a daemon thread; returns (server, thread). ``port=0`` picks
     a free port (``server.server_address[1]``). Used by tests and the
     feedback loop."""
-    server = _make_server(dispatch, host, port, ssl_context)
+    server = _make_server(dispatch, host, port, ssl_context, readiness)
     thread = threading.Thread(target=server.serve_forever, daemon=True)
     thread.start()
     return server, thread

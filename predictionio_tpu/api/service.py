@@ -384,6 +384,20 @@ class EventService:
         event_id = Storage.get_l_events().insert(event, access_key.appid, channel_id)
         return Response(201, {"eventId": event_id})
 
+    # ----------------------------------------------------------- readiness
+    def readiness(self) -> dict:
+        """``GET /readyz`` (served by the HTTP wrapper): an event server
+        is ready when BOTH its stores answer — metadata for access-key
+        resolution, eventdata for the ingest writes themselves (they may
+        be different sources, so each is probed)."""
+        from predictionio_tpu.api.health import (
+            events_check,
+            readiness_report,
+            storage_check,
+        )
+
+        return readiness_report(storage=storage_check(), events=events_check())
+
     # ------------------------------------------------------------ dispatch
     def dispatch(
         self,

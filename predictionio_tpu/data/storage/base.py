@@ -27,6 +27,7 @@ from predictionio_tpu.data.event import Event
 
 __all__ = [
     "StorageError",
+    "StorageUnavailableError",
     "StorageClientConfig",
     "App",
     "AccessKey",
@@ -49,6 +50,16 @@ __all__ = [
 
 class StorageError(RuntimeError):
     """Raised for storage-layer failures (parity: ``StorageException``)."""
+
+
+class StorageUnavailableError(StorageError):
+    """Transport-level failure: the backend could not be reached or did
+    not produce a well-formed answer (connection refused, timeout,
+    mid-body disconnect, HTTP 5xx, open circuit). Distinct from plain
+    :class:`StorageError` so retry policies and circuit breakers act only
+    on faults that retrying can plausibly fix — an application-level
+    error ("unknown method", bad arguments) is deterministic and proves
+    the backend is up."""
 
 
 @dataclass(frozen=True)

@@ -17,6 +17,13 @@ Config (client)::
     PIO_STORAGE_SOURCES_<ID>_PORTS=7072             # default 7072
     PIO_STORAGE_SOURCES_<ID>_SECRET=...             # optional shared secret
     PIO_STORAGE_SOURCES_<ID>_SCHEME=https           # optional (default http)
+    PIO_STORAGE_SOURCES_<ID>_TIMEOUT=30             # per-attempt socket timeout
+    # resilience (docs/operations.md) — all optional, defaults = off:
+    PIO_STORAGE_SOURCES_<ID>_RETRIES=2              # extra attempts for reads
+    PIO_STORAGE_SOURCES_<ID>_RETRY_WRITES=1         # retry writes too (opt-in)
+    PIO_STORAGE_SOURCES_<ID>_BREAKER_THRESHOLD=5    # failures to open breaker
+    PIO_STORAGE_SOURCES_<ID>_BREAKER_RESET_S=5      # open -> half-open probe
+    PIO_STORAGE_SOURCES_<ID>_DEADLINE_S=10          # overall per-call budget
 
 The wire format is one POST ``/rpc`` per repository call:
 ``{"repo": "apps", "method": "insert", "args": {...}}`` →
@@ -36,12 +43,16 @@ from __future__ import annotations
 
 import base64
 import datetime as _dt
+import http.client
 import json
 import logging
+import socket
+import threading
 import urllib.error
 import urllib.request
 from typing import Any, Iterable, Iterator, Mapping, Sequence
 
+from predictionio_tpu import resilience
 from predictionio_tpu.data.event import DataMap, Event
 from predictionio_tpu.data.storage.base import (
     AccessKey,
@@ -61,6 +72,7 @@ from predictionio_tpu.data.storage.base import (
     PEvents,
     StorageClientConfig,
     StorageError,
+    StorageUnavailableError,
 )
 
 __all__ = ["StorageClient", "StorageRpcService"]
@@ -223,13 +235,61 @@ def _find_filter_args(
 # ---------------------------------------------------------------------------
 
 
+def _is_idempotent(method: str) -> bool:
+    """Reads retry by default; writes only when explicitly marked safe
+    (``retry_writes``). Method names are the SPI whitelist's, so a prefix
+    check is exact: every read starts with ``get``/``find``."""
+    return method.startswith(("get", "find"))
+
+
+class _AttemptTimeoutError(StorageUnavailableError):
+    """Module-private marker: the attempt timed out. Needed so breaker
+    accounting can tell a server-is-slow timeout from one manufactured
+    by a deadline-clamped attempt budget."""
+
+
+class _CircuitOpenSignal(Exception):
+    """Module-private: breaker fast-fail. Deliberately OUTSIDE the
+    StorageError hierarchy so the retry policy (which retries
+    StorageUnavailableError) cannot sleep-and-retry against an open
+    circuit — call() converts it at the boundary."""
+
+
 class _Rpc:
-    def __init__(self, base_url: str, secret: str | None, timeout: float):
+    """One storage-server connection's transport policy: per-attempt
+    timeout, optional :class:`~predictionio_tpu.resilience.RetryPolicy`
+    (budgeted by the ambient :func:`~predictionio_tpu.resilience
+    .deadline_scope` so retries never exceed the caller's overall
+    timeout) and optional circuit breaker (a dead storage server fails
+    fast instead of stacking full timeouts under load)."""
+
+    def __init__(
+        self,
+        base_url: str,
+        secret: str | None,
+        timeout: float,
+        policy: "resilience.RetryPolicy | None" = None,
+        breaker: "resilience.CircuitBreaker | None" = None,
+        deadline_s: float = 0.0,
+    ):
         self._url = base_url.rstrip("/") + "/rpc"
         self._secret = secret
         self._timeout = timeout
+        self._policy = policy or resilience.RetryPolicy()
+        self._breaker = breaker
+        self._deadline_s = deadline_s
+        self._lock = threading.Lock()
+        # monotonic counters for /stats.json (see to_json)
+        self._calls = 0
+        self._retries = 0
+        self._failures = 0
+        self._breaker_fast_fails = 0
+        self._deadline_exceeded = 0
 
-    def call(self, repo: str, method: str, args: dict) -> Any:
+    def _attempt(self, repo: str, method: str, args: dict, timeout: float) -> Any:
+        """One wire round trip. Every failure mode maps to a distinct,
+        actionable StorageError; transport-level ones (the only ones a
+        retry can fix) to :class:`StorageUnavailableError`."""
         payload = json.dumps(
             {"repo": repo, "method": method, "args": args}
         ).encode()
@@ -240,24 +300,181 @@ class _Rpc:
             self._url, data=payload, headers=headers, method="POST"
         )
         try:
-            with urllib.request.urlopen(req, timeout=self._timeout) as resp:
-                body = json.loads(resp.read())
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                raw = resp.read()
         except urllib.error.HTTPError as e:
             try:
                 body = json.loads(e.read())
+                detail = body.get("error", e.reason)
             except Exception:
-                body = {"error": f"HTTP {e.code} {e.reason}"}
+                detail = f"HTTP {e.code} {e.reason} (non-JSON error body)"
+            if e.code >= 500:
+                # the server (or a proxy in front of it) is failing, not
+                # rejecting the request — retryable
+                raise StorageUnavailableError(
+                    f"storage server failure for {repo}.{method}: {detail}"
+                ) from e
             raise StorageError(
-                f"storage server error for {repo}.{method}: "
-                f"{body.get('error', e.reason)}"
+                f"storage server error for {repo}.{method}: {detail}"
             ) from e
         except urllib.error.URLError as e:
-            raise StorageError(
-                f"cannot reach storage server at {self._url}: {e.reason}"
+            reason = e.reason
+            if isinstance(reason, ConnectionRefusedError):
+                raise StorageUnavailableError(
+                    f"cannot reach storage server at {self._url} for "
+                    f"{repo}.{method}: connection refused — is "
+                    "`pio storageserver` running?"
+                ) from e
+            if isinstance(reason, (TimeoutError, socket.timeout)):
+                raise _AttemptTimeoutError(
+                    f"cannot reach storage server at {self._url} for "
+                    f"{repo}.{method}: timed out after {timeout:g}s"
+                ) from e
+            raise StorageUnavailableError(
+                f"cannot reach storage server at {self._url}: {reason}"
+            ) from e
+        except http.client.IncompleteRead as e:
+            raise StorageUnavailableError(
+                f"storage server connection lost mid-response for "
+                f"{repo}.{method} ({len(e.partial)} bytes read) — "
+                "server crashed or connection was cut"
+            ) from e
+        except (http.client.HTTPException, ConnectionError) as e:
+            raise StorageUnavailableError(
+                f"storage server connection broke for {repo}.{method}: "
+                f"{type(e).__name__}: {e}"
+            ) from e
+        except TimeoutError as e:
+            raise _AttemptTimeoutError(
+                f"storage server at {self._url} timed out after "
+                f"{timeout:g}s for {repo}.{method}"
+            ) from e
+        try:
+            body = json.loads(raw)
+        except json.JSONDecodeError as e:
+            raise StorageUnavailableError(
+                f"storage server sent a malformed JSON response for "
+                f"{repo}.{method} ({len(raw)} bytes)"
             ) from e
         if "error" in body:
             raise StorageError(body["error"])
         return body.get("result")
+
+    def call(self, repo: str, method: str, args: dict) -> Any:
+        deadline = resilience.current_deadline()
+        own = None
+        if self._deadline_s > 0:
+            # the configured per-call budget composes with any ambient
+            # scope the same way nested scopes do: the tighter one wins
+            own = resilience.Deadline.after(self._deadline_s)
+            if deadline is None or own.remaining() < deadline.remaining():
+                deadline = own
+        # who bounded this call matters for breaker accounting: a CALLER
+        # scope (a readyz probe's 2 s budget) starving an attempt says
+        # nothing about server health, but the transport's own configured
+        # DEADLINE_S is the operator's definition of "too slow" — a
+        # timeout under it must count toward opening the breaker
+        caller_bounded = deadline is not None and deadline is not own
+        with self._lock:
+            self._calls += 1
+
+        def one_attempt() -> Any:
+            if deadline is not None and deadline.expired:
+                with self._lock:
+                    self._deadline_exceeded += 1
+                raise resilience.DeadlineExceededError(
+                    f"deadline exhausted calling {repo}.{method}"
+                )
+            if self._breaker is not None and not self._breaker.acquire():
+                with self._lock:
+                    self._breaker_fast_fails += 1
+                # NOT StorageUnavailableError: the retry policy must not
+                # sleep-and-retry against an open circuit (that would
+                # re-convoy the handler threads the breaker protects);
+                # converted to a StorageUnavailableError below, after run()
+                raise _CircuitOpenSignal()
+            timeout = (
+                self._timeout if deadline is None else
+                max(0.001, deadline.clamp(self._timeout))
+            )
+            clamped_by_caller = caller_bounded and timeout < self._timeout
+            try:
+                result = self._attempt(repo, method, args, timeout)
+            except _AttemptTimeoutError:
+                with self._lock:
+                    self._failures += 1
+                if self._breaker is not None:
+                    if clamped_by_caller:
+                        # the caller's deadline, not the server, bounded
+                        # this attempt — a tight probe budget (readyz's
+                        # 2 s) must not open the breaker shared with
+                        # production calls running the full timeout
+                        self._breaker.record_cancelled()
+                    else:
+                        self._breaker.record_failure()
+                raise
+            except StorageUnavailableError:
+                with self._lock:
+                    self._failures += 1
+                if self._breaker is not None:
+                    self._breaker.record_failure()
+                raise
+            except StorageError:
+                # application-level: the server answered, it is up
+                if self._breaker is not None:
+                    self._breaker.record_success()
+                raise
+            except BaseException:
+                # anything else (SSL error, serialization TypeError, ...):
+                # the acquired breaker slot MUST be released or a failed
+                # half-open probe would wedge the breaker shut forever;
+                # unknown != healthy, so count it as a failure
+                if self._breaker is not None:
+                    self._breaker.record_failure()
+                raise
+            if self._breaker is not None:
+                self._breaker.record_success()
+            return result
+
+        def on_retry(attempt: int, exc: BaseException) -> None:
+            with self._lock:
+                self._retries += 1
+            logger.warning(
+                "retrying %s.%s (attempt %d/%d): %s",
+                repo, method, attempt, self._policy.max_attempts, exc,
+            )
+
+        try:
+            return self._policy.run(
+                one_attempt,
+                retryable=(StorageUnavailableError,),
+                idempotent=_is_idempotent(method),
+                deadline=deadline,
+                on_retry=on_retry,
+            )
+        except _CircuitOpenSignal:
+            raise StorageUnavailableError(
+                f"storage circuit open; failing {repo}.{method} fast "
+                f"(retry in "
+                f"{self._breaker.retry_after_s():.1f}s)"  # type: ignore[union-attr]
+            ) from None
+        except resilience.DeadlineExceededError as e:
+            raise StorageError(str(e)) from e
+
+    def to_json(self) -> dict:
+        with self._lock:
+            out = {
+                "calls": self._calls,
+                "retries": self._retries,
+                "transportFailures": self._failures,
+                "breakerFastFails": self._breaker_fast_fails,
+                "deadlineExceeded": self._deadline_exceeded,
+                "maxAttempts": self._policy.max_attempts,
+            }
+        out["breaker"] = (
+            self._breaker.to_json() if self._breaker is not None else None
+        )
+        return out
 
 
 class _RemoteApps(AppsRepo):
@@ -632,9 +849,42 @@ class StorageClient(BaseStorageClient):
         port = int((props.get("ports") or "7072").split(",")[0])
         scheme = props.get("scheme", "http")
         timeout = float(props.get("timeout", "30"))
-        self._rpc = _Rpc(
-            f"{scheme}://{host}:{port}", props.get("secret"), timeout
+        # resilience knobs: per-source properties override the process-
+        # wide defaults (`pio deploy --retry-*`); built-in defaults are
+        # the do-nothing config — single attempt, no breaker, no deadline
+        dft = resilience.get_rpc_defaults()
+        retries = int(props.get("retries", dft.retries))
+        retry_writes = str(
+            props.get("retry_writes", dft.retry_writes)
+        ).lower() in ("1", "true", "yes")
+        breaker_threshold = int(
+            props.get("breaker_threshold", dft.breaker_threshold)
         )
+        breaker_reset_s = float(
+            props.get("breaker_reset_s", dft.breaker_reset_s)
+        )
+        deadline_s = float(props.get("deadline_s", dft.deadline_s))
+        policy = resilience.RetryPolicy(
+            max_attempts=1 + max(0, retries),
+            base_delay_s=float(props.get("retry_base_delay_s", "0.05")),
+            max_delay_s=float(props.get("retry_max_delay_s", "2.0")),
+            retry_writes=retry_writes,
+        )
+        breaker = (
+            resilience.CircuitBreaker(
+                failure_threshold=breaker_threshold,
+                reset_timeout_s=breaker_reset_s,
+                name=f"storage:{config.source_id}",
+            )
+            if breaker_threshold > 0
+            else None
+        )
+        self._rpc = _Rpc(
+            f"{scheme}://{host}:{port}", props.get("secret"), timeout,
+            policy=policy, breaker=breaker, deadline_s=deadline_s,
+        )
+        # breaker state + retry/abort counters on every /stats.json
+        resilience.register_stats(f"storage_rpc:{config.source_id}", self._rpc)
 
     def get_apps(self) -> AppsRepo:
         return _RemoteApps(self._rpc)
@@ -829,6 +1079,22 @@ class StorageRpcService:
             "items": [_event_to_wire(e) for e in items[:page_limit]],
             "next_offset": offset + page_limit if has_more else None,
         }
+
+    # -- readiness (GET /readyz, served by the HTTP wrapper) ----------------
+    def readiness(self) -> dict:
+        """The storage server is ready iff its *backing* store answers —
+        a pinned test client is probed directly, the registry-backed mode
+        through the shared storage check."""
+        from predictionio_tpu.api.health import readiness_report, storage_check
+
+        if self._client is None:
+            return readiness_report(backing_storage=storage_check())
+        try:
+            self._client.get_apps().get(-1)
+            check = {"ok": True}
+        except Exception as e:
+            check = {"ok": False, "error": str(e)[:200]}
+        return readiness_report(backing_storage=check)
 
     # -- http dispatch (predictionio_tpu.api.http protocol) -----------------
     def dispatch(

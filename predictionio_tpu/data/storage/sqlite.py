@@ -525,7 +525,19 @@ class _SqlEvents(LEvents):
         )
 
     def get(self, event_id: str, app_id: int, channel_id: int | None = None) -> Event | None:
-        t = self._ensure(app_id, channel_id)
+        # read path stays read-only: no _ensure DDL for a stream nobody
+        # wrote to (readiness probes hit this with a phantom app id, and
+        # a probe must not mutate schema — or fail on a read-only db)
+        t = self._table(app_id, channel_id)
+        if (app_id, channel_id) not in self._ensured:
+            if not self._db.query(
+                "SELECT name FROM sqlite_master WHERE type='table' AND name=?",
+                (t,),
+            ):
+                return None
+            # positive existence is cacheable: tables only disappear via
+            # remove(), which discards the cache entry
+            self._ensured.add((app_id, channel_id))
         rows = self._db.query(f"SELECT {_EV_COLS} FROM {t} WHERE id=?", (event_id,))
         return self._from_row(rows[0]) if rows else None
 

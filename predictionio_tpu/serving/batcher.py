@@ -130,13 +130,16 @@ class BatcherConfig:
 
 
 class _Pending:
-    __slots__ = ("body", "enqueued_at", "done", "result")
+    __slots__ = ("body", "enqueued_at", "done", "result", "drained")
 
     def __init__(self, body: Any):
         self.body = body
         self.enqueued_at = time.monotonic()
         self.done = threading.Event()
         self.result: tuple[int, Any] | None = None
+        #: answered by a dead-queue drain (shutdown / dead dispatcher),
+        #: not by a dispatched batch — kept out of the latency stats
+        self.drained = False
 
 
 class MicroBatcher:
@@ -190,6 +193,16 @@ class MicroBatcher:
         cfg = self.config
         if self._closed:
             return 503, {"message": "Serving runtime is shut down."}
+        if not self._thread.is_alive():
+            # a dead dispatcher (a bug — the loop is defensive) must fail
+            # fast with a clean 503, not park the HTTP thread for the
+            # full result timeout; /readyz turns unready via
+            # dispatcher_alive() so orchestrators restart the pod
+            self.stats.record_rejected()
+            return 503, {
+                "message": "Serving runtime dispatcher is not running.",
+                "retryAfterSeconds": self.retry_after_seconds(),
+            }
         pending = _Pending(body)
         try:
             if cfg.admission is AdmissionPolicy.REJECT:
@@ -218,12 +231,40 @@ class MicroBatcher:
             # close()'s own post-join drain; done.set() is at-most-once
             # effective)
             self._drain_dead_queue()
-        if not pending.done.wait(timeout=_RESULT_TIMEOUT_S):
-            return 500, {"message": "Batch dispatcher did not respond."}
+        give_up_at = time.monotonic() + _RESULT_TIMEOUT_S
+        while not pending.done.wait(timeout=1.0):
+            if not self._thread.is_alive():
+                # the dispatcher died while this request was queued:
+                # answer every stranded request (ours included) instead
+                # of letting them sit out the full result timeout
+                self._drain_dead_queue(
+                    "Serving runtime dispatcher died; request not processed."
+                )
+                if pending.done.is_set():
+                    break
+                # in-flight when the dispatcher died (not in the queue):
+                # manufacture the same 503, and count it like every other
+                # rejected response so /stats.json stays truthful during
+                # the incident
+                self.stats.record_rejected()
+                return 503, {
+                    "message": (
+                        "Serving runtime dispatcher died; request not processed."
+                    ),
+                    "retryAfterSeconds": self.retry_after_seconds(),
+                }
+            if time.monotonic() >= give_up_at:
+                return 500, {"message": "Batch dispatcher did not respond."}
         assert pending.result is not None
-        self.stats.record_request(
-            total_ms=(time.monotonic() - pending.enqueued_at) * 1e3
-        )
+        if pending.drained:
+            # a shutdown/dead-dispatcher 503, not a served request: keep
+            # it out of the latency decomposition an operator reads
+            # during exactly this kind of incident
+            self.stats.record_rejected()
+        else:
+            self.stats.record_request(
+                total_ms=(time.monotonic() - pending.enqueued_at) * 1e3
+            )
         return pending.result
 
     def retry_after_seconds(self) -> int:
@@ -255,6 +296,11 @@ class MicroBatcher:
                 continue
             self.stats.record_warmup(size, (time.monotonic() - t0) * 1e3)
 
+    def dispatcher_alive(self) -> bool:
+        """Is the dispatcher thread able to answer submissions? Feeds the
+        query server's ``/readyz`` readiness probe."""
+        return not self._closed and self._thread.is_alive()
+
     def close(self) -> None:
         """Stop the dispatcher. Requests already being drained are
         answered normally; anything still queued (or racing in) gets 503."""
@@ -265,14 +311,17 @@ class MicroBatcher:
         # close may have enqueued after the dispatcher's final drain
         self._drain_dead_queue()
 
-    def _drain_dead_queue(self) -> None:
+    def _drain_dead_queue(
+        self, message: str = "Serving runtime is shut down."
+    ) -> None:
         while True:
             try:
                 item = self._queue.get_nowait()
             except queue.Empty:
                 return
             if item is not None:
-                item.result = (503, {"message": "Serving runtime is shut down."})
+                item.drained = True
+                item.result = (503, {"message": message})
                 item.done.set()
 
     # ------------------------------------------------------------ dispatcher

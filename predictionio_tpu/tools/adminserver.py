@@ -17,6 +17,13 @@ __all__ = ["AdminService"]
 
 
 class AdminService:
+    def readiness(self) -> dict:
+        """``GET /readyz``: the admin API is metadata CRUD — ready iff
+        the metadata store answers."""
+        from predictionio_tpu.api.health import readiness_report, storage_check
+
+        return readiness_report(storage=storage_check())
+
     def dispatch(
         self,
         method: str,

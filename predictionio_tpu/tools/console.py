@@ -168,6 +168,63 @@ def build_parser() -> argparse.ArgumentParser:
         help="sample query body; every bucket shape is pre-compiled with "
         "it at startup so live traffic never recompiles",
     )
+    # ---- resilience (predictionio_tpu.resilience; docs/operations.md).
+    # Defaults are the do-nothing configuration: single-attempt storage
+    # calls, no breaker — identical to a build without these flags.
+    deploy.add_argument(
+        "--retry-reads", type=int, default=0, metavar="N",
+        help="retry idempotent storage reads up to N extra times with "
+        "exponential backoff + full jitter (default 0 = single attempt)",
+    )
+    deploy.add_argument(
+        "--retry-writes", action="store_true",
+        help="also retry storage writes; only safe when writes are "
+        "idempotent (client-generated ids / upserts)",
+    )
+    deploy.add_argument(
+        "--breaker-threshold", type=int, default=0, metavar="N",
+        help="consecutive storage transport failures that open the "
+        "circuit breaker (fail fast instead of stacking timeouts); "
+        "0 = breaker disabled",
+    )
+    deploy.add_argument(
+        "--breaker-reset-s", type=float, default=5.0,
+        help="seconds an open breaker waits before letting one probe "
+        "request through (half-open)",
+    )
+    deploy.add_argument(
+        "--rpc-deadline-s", type=float, default=0.0,
+        help="overall per-call budget consumed across retries, so a "
+        "retried storage call never exceeds it (0 = per-attempt "
+        "timeout only)",
+    )
+    deploy.add_argument(
+        "--feedback-timeout", type=float, default=5.0, metavar="S",
+        help="socket timeout for feedback event posts (worker thread, "
+        "never the query path)",
+    )
+    deploy.add_argument(
+        "--feedback-block-ms", type=float, default=0.0,
+        help="when the feedback queue is full, block the query thread up "
+        "to this long for a slot before dropping (default 0 = drop "
+        "immediately)",
+    )
+    deploy.add_argument(
+        "--no-feedback-blocking", action="store_true",
+        help="force the feedback loop to never block the query path "
+        "(overrides --feedback-block-ms; this is also the default)",
+    )
+    deploy.add_argument(
+        "--feedback-breaker-threshold", type=int, default=0, metavar="N",
+        help="consecutive failed feedback posts that open the feedback "
+        "breaker (drop instantly while the event server is down instead "
+        "of paying a connect timeout per event); 0 = disabled",
+    )
+    deploy.add_argument(
+        "--feedback-breaker-reset-s", type=float, default=5.0,
+        help="seconds an open feedback breaker waits before probing the "
+        "event server again",
+    )
     add_ssl_flags(deploy)
 
     # ---- undeploy
@@ -417,11 +474,22 @@ def main(argv: list[str] | None = None) -> int:
             )
             print(f"Training completed. Engine instance: {instance.id}")
         elif cmd == "deploy":
+            from predictionio_tpu import resilience
             from predictionio_tpu.api.http import serve
             from predictionio_tpu.serving import BatcherConfig
             from predictionio_tpu.workflow import load_engine_variant
             from predictionio_tpu.workflow.serving import FeedbackConfig, QueryService
 
+            # before any storage client exists: the lazily-built remote
+            # driver reads these process-wide defaults (per-source
+            # PIO_STORAGE_SOURCES_<ID>_* properties still win)
+            resilience.set_rpc_defaults(
+                retries=args.retry_reads,
+                retry_writes=args.retry_writes,
+                breaker_threshold=args.breaker_threshold,
+                breaker_reset_s=args.breaker_reset_s,
+                deadline_s=args.rpc_deadline_s,
+            )
             variant = load_engine_variant(args.engine_json)
             feedback = None
             if args.feedback:
@@ -430,6 +498,12 @@ def main(argv: list[str] | None = None) -> int:
                         f"http://{args.event_server_ip}:{args.event_server_port}"
                     ),
                     access_key=args.accesskey,
+                    timeout_s=args.feedback_timeout,
+                    block_ms=(
+                        0.0 if args.no_feedback_blocking else args.feedback_block_ms
+                    ),
+                    breaker_threshold=args.feedback_breaker_threshold,
+                    breaker_reset_s=args.feedback_breaker_reset_s,
                 )
             batching = None
             if args.batching:

@@ -18,6 +18,13 @@ __all__ = ["DashboardService"]
 
 
 class DashboardService:
+    def readiness(self) -> dict:
+        """``GET /readyz``: the dashboard renders evaluation instances
+        from the metadata store — ready iff that store answers."""
+        from predictionio_tpu.api.health import readiness_report, storage_check
+
+        return readiness_report(storage=storage_check())
+
     def _instances(self):
         return sorted(
             Storage.get_meta_data_evaluation_instances().get_completed(),

@@ -18,6 +18,7 @@ import json
 import logging
 import queue
 import threading
+import urllib.error
 import urllib.request
 import uuid
 from typing import Any, Mapping, Sequence
@@ -69,11 +70,31 @@ class EngineServerPlugin:
 
 @dataclasses.dataclass(frozen=True)
 class FeedbackConfig:
-    """Feedback-loop settings (parity: ``--feedback --event-server-*``)."""
+    """Feedback-loop settings (parity: ``--feedback --event-server-*``).
+
+    Feedback is best-effort telemetry by contract: the defaults never let
+    a slow or down event server stall or fail a query. ``block_ms`` opts
+    into briefly blocking the query thread for a queue slot when the
+    queue is full (higher delivery, bounded latency cost); the breaker
+    knobs govern how fast the worker degrades to dropping while the
+    event server is unreachable (docs/operations.md).
+    """
 
     event_server_url: str  # e.g. http://127.0.0.1:7070
     access_key: str
     channel: str | None = None
+    #: socket timeout for each feedback POST (the worker thread's, never
+    #: the query thread's)
+    timeout_s: float = 5.0
+    #: >0: a full feedback queue blocks the query thread up to this long
+    #: before dropping; 0 (default, `--no-feedback-blocking`) never blocks
+    block_ms: float = 0.0
+    #: consecutive post failures that open the feedback breaker — while
+    #: open, events are dropped instantly instead of each paying a full
+    #: connect timeout. 0 (default) disables the breaker: like every
+    #: resilience knob it is strictly opt-in (`--feedback-breaker-threshold`)
+    breaker_threshold: int = 0
+    breaker_reset_s: float = 5.0
 
 
 def _result_to_json(result: Any) -> Any:
@@ -109,6 +130,13 @@ class QueryService:
         self.start_time = _dt.datetime.now(_dt.timezone.utc)
         self.query_count = 0
         self.feedback_dropped = 0
+        self.feedback_sent = 0
+        self.feedback_failed = 0
+        # graceful degradation (docs/operations.md): a failed /reload
+        # keeps serving the last-good model and flags it here
+        self.degraded = False
+        self.last_reload_error: str | None = None
+        self.last_reload_at: _dt.datetime | None = None
         #: set by the transport layer (console deploy): called by
         #: ``GET /stop`` to shut the HTTP server down (parity:
         #: CreateServer's stop route / `pio undeploy`)
@@ -120,8 +148,21 @@ class QueryService:
         # one long-lived worker drains feedback posts — per-query threads
         # would grow unboundedly when the event server is slow
         self._feedback_queue: "queue.Queue | None" = None
+        self._feedback_breaker = None
         if feedback is not None:
+            from predictionio_tpu import resilience
+
             self._feedback_queue = queue.Queue(maxsize=10_000)
+            if feedback.breaker_threshold > 0:
+                # event-server unavailability degrades the loop to
+                # dropping instantly instead of paying a full connect
+                # timeout per event while the server is down
+                self._feedback_breaker = resilience.CircuitBreaker(
+                    failure_threshold=feedback.breaker_threshold,
+                    reset_timeout_s=feedback.breaker_reset_s,
+                    name="feedback",
+                )
+                resilience.register_stats("feedback", self._feedback_breaker)
             threading.Thread(target=self._feedback_worker, daemon=True).start()
         self.reload()
         # cross-request micro-batching (predictionio_tpu.serving): when
@@ -138,18 +179,49 @@ class QueryService:
 
     def _feedback_worker(self) -> None:
         assert self._feedback_queue is not None
+        assert self.feedback is not None
+        timeout_s = self.feedback.timeout_s
+        breaker = self._feedback_breaker
         while True:
             url, event = self._feedback_queue.get()
             try:
-                req = urllib.request.Request(
-                    url,
-                    data=json.dumps(event, default=str).encode(),
-                    headers={"Content-Type": "application/json"},
-                    method="POST",
-                )
-                urllib.request.urlopen(req, timeout=5).read()
-            except Exception:
-                logger.exception("Feedback POST failed")
+                if breaker is not None and not breaker.acquire():
+                    # event server known-down: drop instantly rather than
+                    # paying a full connect timeout per queued event
+                    with self._lock:
+                        self.feedback_dropped += 1
+                    continue
+                try:
+                    req = urllib.request.Request(
+                        url,
+                        data=json.dumps(event, default=str).encode(),
+                        headers={"Content-Type": "application/json"},
+                        method="POST",
+                    )
+                    urllib.request.urlopen(req, timeout=timeout_s).read()
+                except Exception as e:
+                    if breaker is not None:
+                        # 4xx proves the event server is UP (bad access
+                        # key, invalid event) — only transport-level
+                        # failures may open the breaker, same contract as
+                        # the storage RPC
+                        if (
+                            isinstance(e, urllib.error.HTTPError)
+                            and e.code < 500
+                        ):
+                            breaker.record_success()
+                        else:
+                            breaker.record_failure()
+                    with self._lock:
+                        self.feedback_failed += 1
+                    # warning, not exception: a down event server logs one
+                    # line per attempt, and the breaker bounds attempts
+                    logger.warning("Feedback POST failed: %s", e)
+                else:
+                    if breaker is not None:
+                        breaker.record_success()
+                    with self._lock:
+                        self.feedback_sent += 1
             finally:
                 self._feedback_queue.task_done()
 
@@ -175,30 +247,59 @@ class QueryService:
 
     def reload(self) -> None:
         """(Re)hydrate engine + models — the ``/reload`` hot swap
-        (parity: MasterActor re-running prepareDeploy)."""
-        instance = self._resolve_instance()
-        engine = self.variant.build_engine()
-        engine_params = engine.params_from_json(
-            {
-                "datasource": {"params": json.loads(instance.datasource_params or "{}")},
-                "preparator": {"params": json.loads(instance.preparator_params or "{}")},
-                "algorithms": json.loads(instance.algorithms_params or "[]"),
-                "serving": {"params": json.loads(instance.serving_params or "{}")},
-            }
-            if instance.algorithms_params
-            else self.variant.raw
-        )
-        model = Storage.get_model_data_models().get(instance.id)
-        if model is None:
-            raise QueryServerError(f"No model blob for instance '{instance.id}'")
-        serving, pairs = engine.prepare_deploy(
-            self.ctx, engine_params, instance.id, model.models
-        )
+        (parity: MasterActor re-running prepareDeploy).
+
+        Graceful degradation: once a model is serving, a failed reload
+        (storage outage, missing blob, broken variant) NEVER wedges the
+        service — the last-good model keeps serving, ``GET /`` reports
+        ``degraded`` with the error, and the raised
+        :class:`QueryServerError` says so. The initial load still raises:
+        with nothing loaded there is nothing to degrade to."""
+        try:
+            instance = self._resolve_instance()
+            engine = self.variant.build_engine()
+            engine_params = engine.params_from_json(
+                {
+                    "datasource": {"params": json.loads(instance.datasource_params or "{}")},
+                    "preparator": {"params": json.loads(instance.preparator_params or "{}")},
+                    "algorithms": json.loads(instance.algorithms_params or "[]"),
+                    "serving": {"params": json.loads(instance.serving_params or "{}")},
+                }
+                if instance.algorithms_params
+                else self.variant.raw
+            )
+            model = Storage.get_model_data_models().get(instance.id)
+            if model is None:
+                raise QueryServerError(f"No model blob for instance '{instance.id}'")
+            serving, pairs = engine.prepare_deploy(
+                self.ctx, engine_params, instance.id, model.models
+            )
+        except Exception as e:
+            with self._lock:
+                has_last_good = self._serving is not None
+                if has_last_good:
+                    self.degraded = True
+                    self.last_reload_error = str(e)[:500]
+                    self.last_reload_at = _dt.datetime.now(_dt.timezone.utc)
+                    last_good = self.instance.id if self.instance else None
+            if not has_last_good:
+                raise
+            logger.warning(
+                "Reload failed; still serving last-good instance %s: %s",
+                last_good, e,
+            )
+            raise QueryServerError(
+                f"Reload failed (still serving last-good instance "
+                f"'{last_good}'): {e}"
+            ) from e
         with self._lock:
             self._engine = engine
             self._serving = serving
             self._algo_model_pairs = pairs
             self.instance = instance
+            self.degraded = False
+            self.last_reload_error = None
+            self.last_reload_at = _dt.datetime.now(_dt.timezone.utc)
         logger.info("Loaded engine instance %s", instance.id)
 
     # --------------------------------------------------------------- query
@@ -388,7 +489,12 @@ class QueryService:
         if fb.channel:
             url += f"&channel={fb.channel}"
         try:
-            self._feedback_queue.put_nowait((url, event))
+            if fb.block_ms > 0:
+                # opt-in (docs/operations.md): trade a bounded stall for
+                # better delivery when the queue is briefly full
+                self._feedback_queue.put((url, event), timeout=fb.block_ms / 1000.0)
+            else:
+                self._feedback_queue.put_nowait((url, event))
         except queue.Full:
             # feedback is best-effort telemetry; never stall the query
             # path — but surface the loss to operators via status_json
@@ -409,6 +515,13 @@ class QueryService:
             "queryCount": self.query_count,
             "feedbackDropped": self.feedback_dropped,
             "batching": self.batcher is not None,
+            # degraded-mode semantics (docs/operations.md): serving the
+            # last-good model after a failed reload
+            "degraded": self.degraded,
+            "lastReloadError": self.last_reload_error,
+            "lastReloadAt": (
+                self.last_reload_at.isoformat() if self.last_reload_at else None
+            ),
             "plugins": [
                 {"name": p.name, "type": p.plugin_type} for p in self.plugins
             ],
@@ -417,16 +530,52 @@ class QueryService:
     def stats_json(self) -> dict:
         """``GET /stats.json`` payload: query counters plus, when the
         micro-batcher is on, its full gauge/latency decomposition."""
+        from predictionio_tpu import resilience
+
+        # one consistent snapshot of every counter
         with self._lock:
             count = self.query_count
+            feedback_counts = {
+                "sent": self.feedback_sent,
+                "failed": self.feedback_failed,
+                "dropped": self.feedback_dropped,
+            }
+            degraded = self.degraded
         out: dict = {
             "queryCount": count,
             "startTime": self.start_time.isoformat(),
             "batching": self.batcher is not None,
+            "degraded": degraded,
+            # breaker states + retry/abort counters from every registered
+            # transport (storage RPC, feedback loop)
+            "resilience": resilience.stats_snapshot(),
         }
+        if self.feedback is not None:
+            out["feedback"] = feedback_counts
         if self.batcher is not None:
             out["batcher"] = self.batcher.stats.to_json()
         return out
+
+    def readiness(self) -> dict:
+        """``GET /readyz`` (served by the HTTP wrapper): storage
+        reachable, a model loaded, and — when batching is on — the
+        dispatcher thread alive. ``degraded`` (serving last-good after a
+        failed reload) is reported but does NOT fail readiness: the
+        server is still answering queries, which is what readiness
+        gates."""
+        from predictionio_tpu.api.health import readiness_report, storage_check
+
+        with self._lock:
+            model_ok = self._serving is not None
+            degraded = self.degraded
+        batcher_ok = self.batcher is None or self.batcher.dispatcher_alive()
+        report = readiness_report(
+            storage=storage_check(),
+            model_loaded={"ok": model_ok},
+            batcher={"ok": batcher_ok},
+        )
+        report["degraded"] = degraded
+        return report
 
     def close(self) -> None:
         """Release background resources (the batcher's dispatcher thread).
@@ -477,6 +626,15 @@ class QueryService:
                 self.reload()
                 return Response(200, {"message": "Reloaded"})
             except QueryServerError as e:
+                # degraded, not dead: the last-good model is still
+                # serving, so this is an unavailability of the *reload*,
+                # not of the server — 503 + Retry-After, never a raw 500
+                if self.degraded:
+                    return Response(
+                        503,
+                        {"message": str(e), "degraded": True},
+                        headers={"Retry-After": "5"},
+                    )
                 return Response(500, {"message": str(e)})
         if path == "/stop" and method == "GET":
             # parity: CreateServer's stop route; the transport sets

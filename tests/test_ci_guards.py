@@ -81,6 +81,68 @@ def test_serving_runtime_is_accelerator_free():
     assert not offenders, f"serving runtime imports jax: {offenders}"
 
 
+def test_resilience_package_is_stdlib_only_and_jax_free():
+    """predictionio_tpu/resilience/ is host-side failure policy and must
+    stay dependency-free: stdlib imports only (no jax, no numpy, no
+    framework layers) so it can wrap any transport — including the
+    storage registry, which imports it — without cycles or accelerator
+    coupling. An ast walk catches top-level and function-local imports."""
+    pkg = os.path.join(REPO, "predictionio_tpu", "resilience")
+    offenders = []
+    for name in sorted(os.listdir(pkg)):
+        if not name.endswith(".py"):
+            continue
+        with open(os.path.join(pkg, name), "rb") as fh:
+            tree = ast.parse(fh.read(), filename=name)
+        for node in ast.walk(tree):
+            mods = []
+            if isinstance(node, ast.Import):
+                mods = [alias.name for alias in node.names]
+            elif isinstance(node, ast.ImportFrom):
+                if node.level >= 1:
+                    continue  # relative import: intra-package by definition
+                mods = [node.module or ""]
+            for mod in mods:
+                top = mod.split(".")[0]
+                if mod.startswith("predictionio_tpu.resilience"):
+                    continue  # intra-package imports are fine
+                if top not in sys.stdlib_module_names:
+                    offenders.append(f"{name}:{node.lineno}: {mod}")
+    assert not offenders, f"non-stdlib imports in resilience pkg: {offenders}"
+
+
+def test_resilience_defaults_are_do_nothing():
+    """All resilience behavior is strictly opt-in: the built-in defaults
+    must reproduce the prior single-attempt, breaker-less, deadline-less
+    behavior exactly (a 0-retries config == today's behavior)."""
+    from predictionio_tpu import resilience
+    from predictionio_tpu.data.storage import remote
+    from predictionio_tpu.data.storage.base import StorageClientConfig
+    from predictionio_tpu.workflow.serving import FeedbackConfig
+
+    assert resilience.RetryPolicy().max_attempts == 1
+    dft = resilience.RpcDefaults()
+    assert dft.retries == 0
+    assert dft.retry_writes is False
+    assert dft.breaker_threshold == 0  # breaker off
+    assert dft.deadline_s == 0.0  # per-attempt timeout only
+    # a remote client built with no resilience properties: one attempt,
+    # no breaker, no deadline
+    client = remote.StorageClient(
+        StorageClientConfig(
+            "GUARD", "remote", {"hosts": "127.0.0.1", "ports": "1"}
+        )
+    )
+    assert client._rpc._policy.max_attempts == 1
+    assert client._rpc._breaker is None
+    assert client._rpc._deadline_s == 0.0
+    # the feedback loop never blocks the query path by default, and its
+    # breaker (which trades delivery for fast-fail) is opt-in too
+    fb = FeedbackConfig(event_server_url="http://x", access_key="k")
+    assert fb.block_ms == 0.0
+    assert fb.breaker_threshold == 0
+
+
 def test_batching_defaults_leave_single_request_path_alone():
     """Tier-1 latency tests run against the per-request path: batching is
     strictly opt-in (QueryService default None -> no batcher thread), and
@@ -156,3 +218,19 @@ def test_bench_smoke_runs_green():
     batcher = conc["micro_batched"]["batcher"]
     assert batcher["mean_batch_size"] >= 1.0
     assert batcher["bucket_misses_after_warmup"] == 0
+    # resilience section (ISSUE 2 acceptance): through a 2 s injected
+    # storage outage under concurrent load there are no raw query 500s,
+    # the breaker opens and re-closes, and the probes see the outage and
+    # the recovery
+    res = detail.get("resilience")
+    assert res is not None, "missing bench section 'resilience'"
+    assert "error" not in res, f"resilience errored: {res}"
+    assert res["queries"]["raw_500s"] == 0
+    assert res["queries"]["ok"] > 0
+    assert res["goodput_during_outage_qps"] > 0
+    assert res["reload_during_outage_status"] == 503  # degraded, not 500
+    assert res["readyz"]["went_unready"] is True
+    assert res["readyz"]["recovery_seconds"] is not None
+    assert res["breaker"]["opened_count"] >= 1
+    assert res["breaker"]["state_after_recovery"] == "closed"
+    assert res["degraded_after_recovery"] is False

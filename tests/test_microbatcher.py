@@ -298,6 +298,103 @@ class TestBatcherCore:
         assert len(results) == 3
         assert all(s in (200, 503) for s, _ in results)
 
+    def test_graceful_close_drains_in_flight_requests(self):
+        """ISSUE-2 satellite: close() during in-flight traffic — every
+        request either completes normally or gets a clean 503; none hang,
+        none are silently lost."""
+        def slow(bodies):
+            time.sleep(0.05)
+            return _echo_batch(bodies)
+
+        b = MicroBatcher(
+            slow,
+            BatcherConfig(max_batch_size=2, max_batch_delay_ms=0.0, max_queue=64),
+        )
+        results: list[tuple[int, dict]] = []
+        lock = threading.Lock()
+
+        def client(i):
+            r = b.submit({"q": i})
+            with lock:
+                results.append(r)
+
+        threads = [
+            threading.Thread(target=client, args=(i,)) for i in range(12)
+        ]
+        for t in threads:
+            t.start()
+        time.sleep(0.08)  # some batches dispatched, some queued
+        t0 = time.monotonic()
+        b.close()
+        for t in threads:
+            t.join(timeout=15)
+        assert time.monotonic() - t0 < 15  # drained, not timed out
+        assert not any(t.is_alive() for t in threads)  # nobody hangs
+        assert len(results) == 12  # every request got AN answer
+        statuses = [s for s, _ in results]
+        assert all(s in (200, 503) for s in statuses)
+        assert statuses.count(200) >= 1  # in-flight work completed
+
+    @pytest.mark.filterwarnings(
+        "ignore::pytest.PytestUnhandledThreadExceptionWarning"
+    )
+    def test_dead_dispatcher_fails_fast_at_submit(self):
+        """ISSUE-2 satellite: a request must not wait out the full result
+        timeout when the dispatcher thread has died — submit detects it
+        and answers 503 immediately."""
+        def lethal(bodies):
+            raise SystemExit  # escapes _dispatch's except Exception
+
+        b = MicroBatcher(
+            lethal, BatcherConfig(max_batch_size=2, max_batch_delay_ms=0.0)
+        )
+        try:
+            b.submit({"q": 0})  # kills the dispatcher thread
+        except BaseException:
+            pass
+        b._thread.join(timeout=5)
+        assert not b._thread.is_alive()
+        assert b.dispatcher_alive() is False
+        t0 = time.monotonic()
+        status, payload = b.submit({"q": 1})
+        assert time.monotonic() - t0 < 5.0  # fast, not _RESULT_TIMEOUT_S
+        assert status == 503
+        assert "dispatcher" in payload["message"]
+        assert "retryAfterSeconds" in payload
+
+    @pytest.mark.filterwarnings(
+        "ignore::pytest.PytestUnhandledThreadExceptionWarning"
+    )
+    def test_dispatcher_death_releases_queued_requests(self):
+        """A request already queued when the dispatcher dies is answered
+        within seconds, not after the 300 s result timeout."""
+        release = threading.Event()
+        calls = []
+
+        def lethal_after_block(bodies):
+            calls.append(1)
+            release.wait(timeout=10)
+            raise SystemExit
+
+        b = MicroBatcher(
+            lethal_after_block,
+            BatcherConfig(max_batch_size=1, max_batch_delay_ms=0.0, max_queue=8),
+        )
+        results = []
+        t1 = threading.Thread(target=lambda: results.append(b.submit({"q": 0})))
+        t1.start()
+        while not calls:  # first request is inside the handler
+            time.sleep(0.01)
+        t2 = threading.Thread(target=lambda: results.append(b.submit({"q": 1})))
+        t2.start()
+        time.sleep(0.05)  # second request is queued behind the in-flight one
+        release.set()  # dispatcher dies with the queue non-empty
+        t1.join(timeout=10)
+        t2.join(timeout=10)
+        assert not t1.is_alive() and not t2.is_alive()
+        assert len(results) == 2
+        assert all(s == 503 for s, _ in results)
+
 
 class TestQueryServiceIntegration:
     CFG = dict(max_batch_size=8, max_batch_delay_ms=5.0)

@@ -1,0 +1,962 @@
+"""Resilience layer (predictionio_tpu.resilience) — ISSUE 2.
+
+Covers the acceptance surface: retry policy with full-jitter backoff and
+idempotency awareness, deadlines consumed across attempts, the
+closed/open/half-open circuit breaker, the deterministic fault-injection
+harness, the remote-RPC error taxonomy (distinct actionable messages for
+connection refused / non-JSON error bodies / mid-body disconnects),
+``/healthz`` + ``/readyz`` on the shared HTTP wrapper, query-server
+graceful degradation (failed reload keeps serving last-good; feedback
+loop survives a dead event server), and the end-to-end storage-outage
+drill: breaker opens and re-closes, no raw 500s, probes reflect the
+outage and the recovery.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from predictionio_tpu import resilience
+from predictionio_tpu.api.http import start_background
+from predictionio_tpu.controller import local_context
+from predictionio_tpu.data.storage import Storage
+from predictionio_tpu.data.storage.base import (
+    StorageError,
+    StorageUnavailableError,
+)
+from predictionio_tpu.resilience import (
+    CircuitBreaker,
+    CircuitOpenError,
+    Deadline,
+    DeadlineExceededError,
+    FaultError,
+    FaultInjector,
+    RetryPolicy,
+    deadline_scope,
+)
+from predictionio_tpu.workflow import load_engine_variant, run_train
+from predictionio_tpu.workflow.serving import FeedbackConfig, QueryService
+
+VARIANT = {
+    "id": "resilient-engine",
+    "version": "0.1",
+    "engineFactory": "fake_dase:engine0",
+    "datasource": {"params": {"base": 10}},
+    "algorithms": [{"name": "a0", "params": {"mult": 2}}],
+}
+
+
+class _FakeClock:
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+# ---------------------------------------------------------------------------
+# Deadline
+# ---------------------------------------------------------------------------
+
+
+class TestDeadline:
+    def test_remaining_and_expiry(self):
+        clock = _FakeClock()
+        d = Deadline.after(2.0, clock=clock)
+        assert d.remaining() == pytest.approx(2.0)
+        assert not d.expired
+        clock.advance(1.5)
+        assert d.remaining() == pytest.approx(0.5)
+        assert d.clamp(30.0) == pytest.approx(0.5)
+        assert d.clamp(0.1) == pytest.approx(0.1)
+        clock.advance(1.0)
+        assert d.expired
+        assert d.remaining() == 0.0
+
+    def test_scope_propagates_and_nests_tighter(self):
+        assert resilience.current_deadline() is None
+        with deadline_scope(10.0) as outer:
+            assert resilience.current_deadline() is outer
+            # an inner scope cannot EXTEND the outer budget
+            with deadline_scope(60.0) as inner:
+                assert inner is outer
+            # but a tighter inner budget wins
+            with deadline_scope(0.001) as tight:
+                assert tight is not outer
+                assert tight.remaining() <= 0.001
+            assert resilience.current_deadline() is outer
+        assert resilience.current_deadline() is None
+
+
+# ---------------------------------------------------------------------------
+# RetryPolicy
+# ---------------------------------------------------------------------------
+
+
+class TestRetryPolicy:
+    def test_default_is_single_attempt(self):
+        calls = []
+
+        def fn():
+            calls.append(1)
+            raise ValueError("boom")
+
+        with pytest.raises(ValueError):
+            RetryPolicy().run(fn, sleep=lambda s: None)
+        assert len(calls) == 1
+
+    def test_retries_then_succeeds(self):
+        calls = []
+        sleeps = []
+
+        def fn():
+            calls.append(1)
+            if len(calls) < 3:
+                raise ValueError("transient")
+            return "ok"
+
+        policy = RetryPolicy(max_attempts=5, base_delay_s=0.1, max_delay_s=1.0)
+        out = policy.run(fn, sleep=sleeps.append, rng=lambda: 1.0)
+        assert out == "ok"
+        assert len(calls) == 3
+        # full jitter with rng=1.0 gives the cap: base, then 2*base
+        assert sleeps == [pytest.approx(0.1), pytest.approx(0.2)]
+
+    def test_backoff_is_jittered_and_capped(self):
+        policy = RetryPolicy(max_attempts=9, base_delay_s=0.1, max_delay_s=0.5)
+        assert policy.backoff_s(1, rng=lambda: 1.0) == pytest.approx(0.1)
+        assert policy.backoff_s(3, rng=lambda: 1.0) == pytest.approx(0.4)
+        assert policy.backoff_s(8, rng=lambda: 1.0) == pytest.approx(0.5)  # cap
+        assert policy.backoff_s(4, rng=lambda: 0.0) == 0.0  # full jitter -> 0
+
+    def test_writes_not_retried_unless_marked_safe(self):
+        calls = []
+
+        def fn():
+            calls.append(1)
+            raise ValueError("boom")
+
+        policy = RetryPolicy(max_attempts=3, base_delay_s=0.0)
+        with pytest.raises(ValueError):
+            policy.run(fn, idempotent=False, sleep=lambda s: None)
+        assert len(calls) == 1  # a write got exactly one attempt
+        calls.clear()
+        safe = RetryPolicy(max_attempts=3, base_delay_s=0.0, retry_writes=True)
+        with pytest.raises(ValueError):
+            safe.run(fn, idempotent=False, sleep=lambda s: None)
+        assert len(calls) == 3
+
+    def test_only_retryable_exceptions_retry(self):
+        calls = []
+
+        def fn():
+            calls.append(1)
+            raise KeyError("deterministic")
+
+        policy = RetryPolicy(max_attempts=3, base_delay_s=0.0)
+        with pytest.raises(KeyError):
+            policy.run(fn, retryable=(ValueError,), sleep=lambda s: None)
+        assert len(calls) == 1
+
+    def test_deadline_budget_consumed_across_attempts(self):
+        clock = _FakeClock()
+        deadline = Deadline.after(1.0, clock=clock)
+        calls = []
+
+        def fn():
+            calls.append(1)
+            clock.advance(0.4)  # each attempt costs 0.4s of budget
+            raise ValueError("transient")
+
+        policy = RetryPolicy(max_attempts=10, base_delay_s=0.0)
+        with pytest.raises(ValueError):
+            policy.run(fn, deadline=deadline, sleep=lambda s: None)
+        # 1.0s budget / 0.4s per attempt -> the 3rd attempt exhausts it;
+        # without the deadline this would have been 10 attempts
+        assert len(calls) == 3
+
+    def test_expired_deadline_before_first_attempt(self):
+        clock = _FakeClock()
+        deadline = Deadline.after(0.0, clock=clock)
+        clock.advance(0.1)
+        with pytest.raises(DeadlineExceededError):
+            RetryPolicy(max_attempts=3).run(
+                lambda: "never", deadline=deadline
+            )
+
+    def test_backoff_never_burns_the_remaining_budget(self):
+        """When the backoff sleep would consume everything left of the
+        deadline, the REAL failure is re-raised immediately — the caller
+        gets the actionable error, not a late 'deadline exhausted'."""
+        clock = _FakeClock()
+        deadline = Deadline.after(0.3, clock=clock)
+        sleeps = []
+        attempts = []
+
+        def fn():
+            attempts.append(1)
+            clock.advance(0.1)
+            raise ValueError("the real failure")
+
+        policy = RetryPolicy(max_attempts=5, base_delay_s=10.0, max_delay_s=10.0)
+        with pytest.raises(ValueError, match="the real failure"):
+            policy.run(
+                fn, deadline=deadline, sleep=sleeps.append, rng=lambda: 1.0
+            )
+        # the 10 s backoff exceeds the 0.2 s left after attempt 1: raise
+        # now, sleep never
+        assert attempts == [1]
+        assert sleeps == []
+
+    def test_small_backoffs_still_sleep_within_budget(self):
+        clock = _FakeClock()
+        deadline = Deadline.after(1.0, clock=clock)
+        sleeps = []
+
+        def fn():
+            clock.advance(0.1)
+            raise ValueError("transient")
+
+        policy = RetryPolicy(max_attempts=3, base_delay_s=0.05, max_delay_s=0.05)
+        with pytest.raises(ValueError):
+            policy.run(
+                fn, deadline=deadline, sleep=sleeps.append, rng=lambda: 1.0
+            )
+        assert sleeps == [pytest.approx(0.05), pytest.approx(0.05)]
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            RetryPolicy(max_attempts=0)
+        with pytest.raises(ValueError):
+            RetryPolicy(base_delay_s=-1)
+
+
+# ---------------------------------------------------------------------------
+# CircuitBreaker
+# ---------------------------------------------------------------------------
+
+
+class TestCircuitBreaker:
+    def test_opens_after_threshold_and_fails_fast(self):
+        clock = _FakeClock()
+        b = CircuitBreaker(failure_threshold=3, reset_timeout_s=5.0, clock=clock)
+        for _ in range(2):
+            assert b.acquire()
+            b.record_failure()
+        assert b.state == "closed"
+        assert b.acquire()
+        b.record_failure()
+        assert b.state == "open"
+        assert not b.acquire()  # fast fail, no call
+        assert b.to_json()["fastFails"] == 1
+        assert 0 < b.retry_after_s() <= 5.0
+
+    def test_success_resets_failure_streak(self):
+        b = CircuitBreaker(failure_threshold=2)
+        b.acquire(); b.record_failure()
+        b.acquire(); b.record_success()
+        b.acquire(); b.record_failure()
+        assert b.state == "closed"  # never two CONSECUTIVE failures
+
+    def test_half_open_probe_success_closes(self):
+        clock = _FakeClock()
+        b = CircuitBreaker(failure_threshold=1, reset_timeout_s=2.0, clock=clock)
+        b.acquire(); b.record_failure()
+        assert b.state == "open"
+        clock.advance(2.5)
+        assert b.acquire()  # the single probe
+        assert not b.acquire()  # only ONE probe at a time
+        b.record_success()
+        assert b.state == "closed"
+        assert b.acquire()
+
+    def test_half_open_probe_failure_reopens_full_window(self):
+        clock = _FakeClock()
+        b = CircuitBreaker(failure_threshold=1, reset_timeout_s=2.0, clock=clock)
+        b.acquire(); b.record_failure()
+        clock.advance(2.5)
+        assert b.acquire()
+        b.record_failure()
+        assert b.state == "open"
+        clock.advance(1.0)  # not a full reset window since the probe failed
+        assert not b.acquire()
+        clock.advance(1.5)
+        assert b.acquire()
+        assert b.to_json()["openedCount"] == 2
+
+    def test_call_wrapper_raises_circuit_open(self):
+        clock = _FakeClock()
+        b = CircuitBreaker(failure_threshold=1, reset_timeout_s=9.0, clock=clock)
+        with pytest.raises(ValueError):
+            b.call(lambda: (_ for _ in ()).throw(ValueError("x")))
+        with pytest.raises(CircuitOpenError) as e:
+            b.call(lambda: "never")
+        assert e.value.retry_after_s > 0
+
+
+# ---------------------------------------------------------------------------
+# FaultInjector
+# ---------------------------------------------------------------------------
+
+
+class TestFaultInjector:
+    def test_fail_next_is_exact(self):
+        inj = FaultInjector()
+        fn = inj.wrap(lambda: "ok")
+        inj.fail_next(2)
+        with pytest.raises(FaultError):
+            fn()
+        with pytest.raises(FaultError):
+            fn()
+        assert fn() == "ok"
+        assert inj.injected_errors == 2 and inj.calls == 3
+
+    def test_fail_for_window(self):
+        clock = _FakeClock()
+        inj = FaultInjector(clock=clock)
+        fn = inj.wrap(lambda: "ok")
+        inj.fail_for(2.0)
+        with pytest.raises(FaultError):
+            fn()
+        clock.advance(2.5)
+        assert fn() == "ok"
+
+    def test_script_steps(self):
+        inj = FaultInjector()
+        fn = inj.wrap(lambda: "ok")
+        inj.script(["ok", "error", "delay:1", "ok"])
+        assert fn() == "ok"
+        with pytest.raises(FaultError):
+            fn()
+        t0 = time.monotonic()
+        assert fn() == "ok"  # delayed ~1 ms
+        assert time.monotonic() - t0 < 0.5
+        assert fn() == "ok"
+        assert inj.injected_delays == 1
+
+    def test_flap_alternates(self):
+        clock = _FakeClock()
+        inj = FaultInjector(clock=clock)
+        fn = inj.wrap(lambda: "ok")
+        inj.flap(period_s=1.0)
+        with pytest.raises(FaultError):
+            fn()  # starts down
+        clock.advance(1.0)
+        assert fn() == "ok"  # up window
+        clock.advance(1.0)
+        with pytest.raises(FaultError):
+            fn()  # down again
+        inj.clear()
+        assert fn() == "ok"
+
+    def test_wrap_repo_proxies_methods(self):
+        class Repo:
+            def get(self, x):
+                return x * 2
+
+            def name(self):
+                return "repo"
+
+        inj = FaultInjector()
+        faulty = inj.wrap_repo(Repo())
+        assert faulty.get(21) == 42
+        inj.fail_next(1)
+        with pytest.raises(FaultError):
+            faulty.get(1)
+        assert faulty.name() == "repo"
+
+
+# ---------------------------------------------------------------------------
+# Remote RPC: error taxonomy, retries, breaker, deadline (satellite + tentpole)
+# ---------------------------------------------------------------------------
+
+
+class _FakeStorageServer:
+    """Raw HTTP stand-in for `pio storageserver` with scriptable failure
+    modes: 'ok', 'http500_html', 'midbody', 'garbage', 'error400'."""
+
+    def __init__(self):
+        self.hits = 0
+        self.mode: "str | list" = "ok"
+        self._lock = threading.Lock()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                with outer._lock:
+                    outer.hits += 1
+                    mode = outer.mode
+                    step = mode.pop(0) if isinstance(mode, list) and mode else (
+                        mode if isinstance(mode, str) else "ok"
+                    )
+                self.rfile.read(int(self.headers.get("Content-Length") or 0))
+                if step == "ok":
+                    self._body(200, json.dumps({"result": "fine"}).encode())
+                elif step == "http500_html":
+                    self._body(
+                        500, b"<html>Internal Server Error</html>", "text/html"
+                    )
+                elif step == "error400":
+                    self._body(
+                        400, json.dumps({"error": "unknown method 'x'"}).encode()
+                    )
+                elif step == "garbage":
+                    self._body(200, b"this is not json")
+                elif step == "slow":
+                    time.sleep(0.5)
+                    self._body(200, json.dumps({"result": "fine"}).encode())
+                elif step == "midbody":
+                    # declare 1000 bytes, send 10, cut the connection
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", "1000")
+                    self.end_headers()
+                    self.wfile.write(b'{"result": ')
+                    self.wfile.flush()
+                    self.connection.close()
+
+            def _body(self, status, payload, ctype="application/json"):
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self.server.server_address[1]
+        threading.Thread(target=self.server.serve_forever, daemon=True).start()
+
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def close(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+@pytest.fixture()
+def fake_server():
+    s = _FakeStorageServer()
+    yield s
+    s.close()
+
+
+def _rpc(url: str, **kwargs):
+    from predictionio_tpu.data.storage.remote import _Rpc
+
+    kwargs.setdefault("timeout", 5.0)
+    return _Rpc(url, None, **kwargs)
+
+
+class TestRpcErrorTaxonomy:
+    """Satellite: HTTP error with non-JSON body, connection refused, and
+    mid-body disconnect each produce a distinct, actionable message."""
+
+    def test_connection_refused(self):
+        rpc = _rpc("http://127.0.0.1:1")
+        with pytest.raises(StorageUnavailableError) as e:
+            rpc.call("apps", "get_all", {})
+        msg = str(e.value)
+        assert "connection refused" in msg
+        assert "pio storageserver" in msg  # actionable: tells the fix
+        assert "apps.get_all" in msg
+
+    def test_http_error_with_non_json_body(self, fake_server):
+        fake_server.mode = "http500_html"
+        rpc = _rpc(fake_server.url())
+        with pytest.raises(StorageUnavailableError) as e:
+            rpc.call("apps", "get_all", {})
+        assert "non-JSON error body" in str(e.value)
+        assert "HTTP 500" in str(e.value)
+
+    def test_mid_body_disconnect(self, fake_server):
+        fake_server.mode = "midbody"
+        rpc = _rpc(fake_server.url())
+        with pytest.raises(StorageUnavailableError) as e:
+            rpc.call("apps", "get_all", {})
+        msg = str(e.value)
+        assert "mid-response" in msg
+        assert "bytes read" in msg  # says how far it got
+
+    def test_garbage_200_body(self, fake_server):
+        fake_server.mode = "garbage"
+        rpc = _rpc(fake_server.url())
+        with pytest.raises(StorageUnavailableError) as e:
+            rpc.call("apps", "get_all", {})
+        assert "malformed JSON" in str(e.value)
+
+    def test_application_error_is_plain_storage_error(self, fake_server):
+        fake_server.mode = "error400"
+        rpc = _rpc(fake_server.url())
+        with pytest.raises(StorageError) as e:
+            rpc.call("apps", "get_all", {})
+        assert not isinstance(e.value, StorageUnavailableError)
+        assert "unknown method" in str(e.value)
+
+
+class TestRpcRetryBreakerDeadline:
+    def test_default_is_exactly_one_attempt(self, fake_server):
+        fake_server.mode = "http500_html"
+        rpc = _rpc(fake_server.url())
+        with pytest.raises(StorageUnavailableError):
+            rpc.call("apps", "get_all", {})
+        assert fake_server.hits == 1  # today's single-attempt behavior
+
+    def test_reads_retry_through_transient_failures(self, fake_server):
+        fake_server.mode = ["http500_html", "http500_html", "ok"]
+        rpc = _rpc(
+            fake_server.url(),
+            policy=RetryPolicy(max_attempts=3, base_delay_s=0.001),
+        )
+        assert rpc.call("apps", "get_all", {}) == "fine"
+        assert fake_server.hits == 3
+        assert rpc.to_json()["retries"] == 2
+
+    def test_writes_do_not_retry_by_default(self, fake_server):
+        fake_server.mode = "http500_html"
+        rpc = _rpc(
+            fake_server.url(),
+            policy=RetryPolicy(max_attempts=3, base_delay_s=0.001),
+        )
+        with pytest.raises(StorageUnavailableError):
+            rpc.call("apps", "insert", {"app": {}})
+        assert fake_server.hits == 1
+
+    def test_app_errors_never_retry(self, fake_server):
+        fake_server.mode = "error400"
+        rpc = _rpc(
+            fake_server.url(),
+            policy=RetryPolicy(max_attempts=5, base_delay_s=0.001),
+        )
+        with pytest.raises(StorageError):
+            rpc.call("apps", "get_all", {})
+        assert fake_server.hits == 1
+
+    def test_breaker_opens_and_fails_fast_then_recovers(self, fake_server):
+        fake_server.mode = "http500_html"
+        rpc = _rpc(
+            fake_server.url(),
+            breaker=CircuitBreaker(failure_threshold=2, reset_timeout_s=0.2),
+        )
+        for _ in range(2):
+            with pytest.raises(StorageUnavailableError):
+                rpc.call("apps", "get_all", {})
+        assert fake_server.hits == 2
+        # breaker open: fails fast WITHOUT touching the server
+        with pytest.raises(StorageUnavailableError) as e:
+            rpc.call("apps", "get_all", {})
+        assert "circuit open" in str(e.value)
+        assert fake_server.hits == 2
+        # server recovers; after the reset window one probe closes it
+        fake_server.mode = "ok"
+        time.sleep(0.25)
+        assert rpc.call("apps", "get_all", {}) == "fine"
+        assert rpc.to_json()["breaker"]["state"] == "closed"
+        assert rpc.to_json()["breaker"]["openedCount"] == 1
+
+    def test_open_circuit_fails_fast_without_retry_sleeps(self, fake_server):
+        """Fast-fails must not be retried with backoff sleeps — that
+        would re-convoy the handler threads the breaker protects."""
+        fake_server.mode = "http500_html"
+        rpc = _rpc(
+            fake_server.url(),
+            policy=RetryPolicy(
+                max_attempts=5, base_delay_s=0.5, max_delay_s=0.5
+            ),
+            breaker=CircuitBreaker(failure_threshold=1, reset_timeout_s=60.0),
+        )
+        with pytest.raises(StorageUnavailableError):
+            rpc.call("apps", "get_all", {})  # opens the breaker
+        hits = fake_server.hits
+        retries_before = rpc.to_json()["retries"]
+        t0 = time.monotonic()
+        with pytest.raises(StorageUnavailableError) as e:
+            rpc.call("apps", "get_all", {})
+        assert "circuit open" in str(e.value)
+        assert time.monotonic() - t0 < 0.4  # no backoff sleeps happened
+        assert fake_server.hits == hits  # server never touched
+        assert rpc.to_json()["retries"] == retries_before
+
+    def test_deadline_clamped_timeout_does_not_open_breaker(self, fake_server):
+        """A readiness probe's tight deadline starving a slow-but-healthy
+        server must not open the breaker shared with production calls
+        that run the full timeout."""
+        fake_server.mode = "slow"  # answers in ~0.5 s
+        breaker = CircuitBreaker(failure_threshold=1, reset_timeout_s=60.0)
+        rpc = _rpc(fake_server.url(), timeout=30.0, breaker=breaker)
+        with deadline_scope(0.1):  # probe budget far below response time
+            with pytest.raises(StorageError):
+                rpc.call("apps", "get_all", {})
+        assert breaker.state == "closed"  # health unknown, not failed
+        # production call with the full timeout still goes through
+        assert rpc.call("apps", "get_all", {}) == "fine"
+
+    def test_configured_deadline_timeout_does_open_breaker(self, fake_server):
+        """The transport's own DEADLINE_S is the operator's definition of
+        'too slow': a server black-holing past it must open the breaker
+        (unlike a caller-scope clamp, which is breaker-neutral)."""
+        fake_server.mode = "slow"  # answers in ~0.5 s
+        breaker = CircuitBreaker(failure_threshold=1, reset_timeout_s=60.0)
+        rpc = _rpc(
+            fake_server.url(), timeout=30.0, breaker=breaker, deadline_s=0.1
+        )
+        with pytest.raises(StorageError):
+            rpc.call("apps", "get_all", {})
+        assert breaker.state == "open"
+
+    def test_deadline_scope_bounds_total_time(self, fake_server):
+        fake_server.mode = "http500_html"
+        rpc = _rpc(
+            fake_server.url(),
+            policy=RetryPolicy(
+                max_attempts=50, base_delay_s=0.2, max_delay_s=0.2
+            ),
+        )
+        t0 = time.monotonic()
+        with deadline_scope(0.5):
+            with pytest.raises(StorageError):
+                rpc.call("apps", "get_all", {})
+        # 50 attempts at ~0.2s backoff would take ~10s; the deadline
+        # budget cut it off around 0.5s
+        assert time.monotonic() - t0 < 2.0
+
+    def test_stats_registered_for_remote_client(self, fake_server):
+        from predictionio_tpu.data.storage import remote
+        from predictionio_tpu.data.storage.base import StorageClientConfig
+
+        client = remote.StorageClient(
+            StorageClientConfig(
+                "RESTEST", "remote",
+                {
+                    "hosts": "127.0.0.1", "ports": str(fake_server.port),
+                    "retries": "2", "breaker_threshold": "4",
+                },
+            )
+        )
+        snap = resilience.stats_snapshot()
+        assert "storage_rpc:RESTEST" in snap
+        entry = snap["storage_rpc:RESTEST"]
+        assert entry["maxAttempts"] == 3
+        assert entry["breaker"]["state"] == "closed"
+        del client
+
+
+# ---------------------------------------------------------------------------
+# Health endpoints on the shared HTTP wrapper
+# ---------------------------------------------------------------------------
+
+
+def _get(port: int, path: str):
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=10
+        ) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+class TestHealthEndpoints:
+    def test_probes_free_on_any_dispatcher(self):
+        """A server whose service has no readiness hook still gets both
+        probes: /healthz and /readyz answer 200."""
+        from predictionio_tpu.api.service import Response
+
+        def dispatch(**kwargs):
+            return Response(200, {"ok": True})
+
+        server, _ = start_background(dispatch)
+        try:
+            port = server.server_address[1]
+            assert _get(port, "/healthz") == (200, {"status": "ok"})
+            status, body = _get(port, "/readyz")
+            assert status == 200 and body["ready"] is True
+        finally:
+            server.shutdown()
+            server.server_close()
+
+    def test_event_server_readyz_tracks_storage(self, memory_storage_env):
+        from predictionio_tpu.api import EventService
+
+        server, _ = start_background(EventService().dispatch)
+        try:
+            port = server.server_address[1]
+            status, body = _get(port, "/readyz")
+            assert status == 200
+            assert body["checks"]["storage"]["ok"] is True
+            # the ingest-path store is probed separately: it can be a
+            # different source than metadata
+            assert body["checks"]["events"]["ok"] is True
+        finally:
+            server.shutdown()
+            server.server_close()
+
+    def test_readyz_503_when_storage_unreachable(self):
+        from predictionio_tpu.api import EventService
+
+        Storage.configure(
+            {
+                "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "DEAD",
+                "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "DEAD",
+                "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "DEAD",
+                "PIO_STORAGE_SOURCES_DEAD_TYPE": "remote",
+                "PIO_STORAGE_SOURCES_DEAD_HOSTS": "127.0.0.1",
+                "PIO_STORAGE_SOURCES_DEAD_PORTS": "1",
+            }
+        )
+        try:
+            server, _ = start_background(EventService().dispatch)
+            try:
+                port = server.server_address[1]
+                status, body = _get(port, "/readyz")
+                assert status == 503
+                assert body["ready"] is False
+                assert body["checks"]["storage"]["ok"] is False
+                # liveness is about the process, not dependencies
+                assert _get(port, "/healthz")[0] == 200
+            finally:
+                server.shutdown()
+                server.server_close()
+        finally:
+            Storage.configure(None)
+
+    def test_query_server_readyz(self, memory_storage_env):
+        variant = load_engine_variant(VARIANT)
+        run_train(variant, local_context())
+        qs = QueryService(variant)
+        server, _ = start_background(qs.dispatch)
+        try:
+            port = server.server_address[1]
+            status, body = _get(port, "/readyz")
+            assert status == 200
+            assert body["checks"]["model_loaded"]["ok"] is True
+            assert body["checks"]["batcher"]["ok"] is True
+            assert body["degraded"] is False
+        finally:
+            server.shutdown()
+            server.server_close()
+
+
+# ---------------------------------------------------------------------------
+# Query-server graceful degradation
+# ---------------------------------------------------------------------------
+
+
+class TestDegradedReload:
+    def test_failed_reload_keeps_serving_last_good(
+        self, memory_storage_env, monkeypatch
+    ):
+        variant = load_engine_variant(VARIANT)
+        run_train(variant, local_context())
+        qs = QueryService(variant)
+        good_instance = qs.instance.id
+        status, payload = qs.handle_query(4)
+        assert status == 200
+
+        def broken_resolve():
+            raise StorageUnavailableError("storage is down")
+
+        monkeypatch.setattr(qs, "_resolve_instance", broken_resolve)
+        resp = qs.dispatch("POST", "/reload", {})
+        assert resp.status == 503  # degraded unavailability, not a raw 500
+        assert resp.headers["Retry-After"]
+        assert "last-good" in resp.body["message"]
+        # still serving the last-good model
+        status, payload = qs.handle_query(4)
+        assert status == 200
+        root = qs.dispatch("GET", "/", {})
+        assert root.body["degraded"] is True
+        assert "storage is down" in root.body["lastReloadError"]
+        assert root.body["engineInstanceId"] == good_instance
+        assert qs.readiness()["degraded"] is True
+        # storage comes back: next reload clears the degraded flag
+        monkeypatch.undo()
+        resp = qs.dispatch("POST", "/reload", {})
+        assert resp.status == 200
+        assert qs.dispatch("GET", "/", {}).body["degraded"] is False
+
+    def test_initial_load_failure_still_raises(self, memory_storage_env):
+        from predictionio_tpu.workflow.serving import QueryServerError
+
+        variant = load_engine_variant(VARIANT)  # nothing trained
+        with pytest.raises(QueryServerError, match="No COMPLETED training"):
+            QueryService(variant)
+
+    def test_stats_json_has_resilience_section(self, memory_storage_env):
+        variant = load_engine_variant(VARIANT)
+        run_train(variant, local_context())
+        qs = QueryService(variant)
+        stats = qs.stats_json()
+        assert "resilience" in stats
+        assert stats["degraded"] is False
+
+
+class TestFeedbackIsolation:
+    """Satellite: a slow/down event server must never stall or fail the
+    query path — posts run on the worker behind a timeout + breaker."""
+
+    def test_defaults_never_block_query_path(self):
+        fb = FeedbackConfig(event_server_url="http://x", access_key="k")
+        assert fb.block_ms == 0.0
+        assert fb.timeout_s == 5.0
+
+    def test_queries_succeed_fast_with_dead_event_server(
+        self, memory_storage_env
+    ):
+        variant = load_engine_variant(VARIANT)
+        run_train(variant, local_context())
+        qs = QueryService(
+            variant,
+            feedback=FeedbackConfig(
+                event_server_url="http://127.0.0.1:1",  # connection refused
+                access_key="k",
+                timeout_s=0.5,
+                breaker_threshold=2,
+                breaker_reset_s=30.0,
+            ),
+        )
+        t0 = time.monotonic()
+        for i in range(50):
+            status, _ = qs.handle_query(i)
+            assert status == 200
+        # the query path never waited on the event server
+        assert time.monotonic() - t0 < 5.0
+        # the worker degraded to dropping: breaker opened after 2 refused
+        # posts, the rest were dropped without an attempt
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            with qs._lock:
+                done = (
+                    qs.feedback_failed + qs.feedback_dropped + qs.feedback_sent
+                )
+            if done >= 50:
+                break
+            time.sleep(0.05)
+        assert qs.feedback_sent == 0
+        assert qs.feedback_failed >= 2
+        assert qs.feedback_dropped >= 1
+        assert qs._feedback_breaker.state == "open"
+        assert resilience.stats_snapshot()["feedback"]["state"] == "open"
+
+
+# ---------------------------------------------------------------------------
+# End-to-end storage outage drill (acceptance criteria, test-sized)
+# ---------------------------------------------------------------------------
+
+
+class TestStorageOutageDrill:
+    def test_outage_and_recovery(self, tmp_path):
+        """Remote storage behind a fault injector: during an injected
+        outage the breaker opens, /readyz flips unready, /reload degrades
+        instead of wedging, queries keep answering (no raw 500s); after
+        the outage everything recovers."""
+        from predictionio_tpu.data.storage import sqlite as sqlite_driver
+        from predictionio_tpu.data.storage.base import StorageClientConfig
+        from predictionio_tpu.data.storage.remote import StorageRpcService
+
+        backing = sqlite_driver.StorageClient(
+            StorageClientConfig("B", "sqlite", {"path": str(tmp_path / "b.db")})
+        )
+        inj = FaultInjector()
+        rpc_service = StorageRpcService(client=backing)
+        storage_server, _ = start_background(inj.wrap_dispatch(rpc_service.dispatch))
+        storage_port = storage_server.server_address[1]
+        Storage.configure(
+            {
+                "PIO_FS_BASEDIR": str(tmp_path),
+                "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "NET",
+                "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "NET",
+                "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "NET",
+                "PIO_STORAGE_SOURCES_NET_TYPE": "remote",
+                "PIO_STORAGE_SOURCES_NET_HOSTS": "127.0.0.1",
+                "PIO_STORAGE_SOURCES_NET_PORTS": str(storage_port),
+                "PIO_STORAGE_SOURCES_NET_RETRIES": "1",
+                "PIO_STORAGE_SOURCES_NET_RETRY_BASE_DELAY_S": "0.01",
+                "PIO_STORAGE_SOURCES_NET_BREAKER_THRESHOLD": "2",
+                "PIO_STORAGE_SOURCES_NET_BREAKER_RESET_S": "0.2",
+            }
+        )
+        try:
+            variant = load_engine_variant(VARIANT)
+            run_train(variant, local_context())
+            qs = QueryService(variant)
+            server, _ = start_background(qs.dispatch)
+            port = server.server_address[1]
+            try:
+                assert _get(port, "/readyz")[0] == 200
+
+                inj.fail_for(1.0)
+                # readiness reflects the outage (breaker opens along the way)
+                deadline = time.monotonic() + 2.0
+                saw_unready = False
+                while time.monotonic() < deadline:
+                    if _get(port, "/readyz")[0] == 503:
+                        saw_unready = True
+                        break
+                    time.sleep(0.02)
+                assert saw_unready
+                # reload during the outage: degraded 503, never a raw 500
+                body = json.dumps({}).encode()
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{port}/reload", data=body,
+                    headers={"Content-Type": "application/json"},
+                )
+                with pytest.raises(urllib.error.HTTPError) as e:
+                    urllib.request.urlopen(req, timeout=30)
+                assert e.value.code == 503
+                # queries still answer from the in-memory model
+                q = urllib.request.Request(
+                    f"http://127.0.0.1:{port}/queries.json",
+                    data=json.dumps(4).encode(),
+                    headers={"Content-Type": "application/json"},
+                )
+                with urllib.request.urlopen(q, timeout=30) as r:
+                    assert r.status == 200
+                status, stats = _get(port, "/stats.json")
+                breaker = stats["resilience"]["storage_rpc:NET"]["breaker"]
+                assert breaker["state"] in ("open", "half_open")
+
+                # outage ends: probes re-close the breaker, /readyz greens
+                deadline = time.monotonic() + 10.0
+                recovered = False
+                while time.monotonic() < deadline:
+                    if _get(port, "/readyz")[0] == 200:
+                        recovered = True
+                        break
+                    time.sleep(0.05)
+                assert recovered
+                assert _get(port, "/reload")  # route exists; POST to reload:
+                resp = urllib.request.urlopen(
+                    urllib.request.Request(
+                        f"http://127.0.0.1:{port}/reload", data=body,
+                        headers={"Content-Type": "application/json"},
+                    ),
+                    timeout=30,
+                )
+                assert resp.status == 200
+                status, stats = _get(port, "/stats.json")
+                breaker = stats["resilience"]["storage_rpc:NET"]["breaker"]
+                assert breaker["state"] == "closed"
+                assert breaker["openedCount"] >= 1
+                assert stats["degraded"] is False
+            finally:
+                server.shutdown()
+                server.server_close()
+        finally:
+            Storage.configure(None)
+            storage_server.shutdown()
+            storage_server.server_close()
+            backing.close()
