@@ -841,7 +841,7 @@ def _bench_serving_concurrent(n_clients: int, per_client: int) -> dict:
                     conn.close()
 
                 threads = [
-                    threading.Thread(target=client, args=(c,))
+                    threading.Thread(target=client, args=(c,), daemon=True)
                     for c in range(n_clients)
                 ]
                 for t in threads:
@@ -1071,9 +1071,9 @@ def _bench_resilience(outage_s: float, n_clients: int) -> dict:
                     time.sleep(0.025)
 
             threads = [
-                threading.Thread(target=client, args=(c,))
+                threading.Thread(target=client, args=(c,), daemon=True)
                 for c in range(n_clients)
-            ] + [threading.Thread(target=prober)]
+            ] + [threading.Thread(target=prober, daemon=True)]
             for t in threads:
                 t.start()
 
@@ -1404,6 +1404,28 @@ def _bench_event_ingest(Storage, app_id, rng, num_users, num_items) -> dict:
 # ---------------------------------------------------------------------------
 
 
+def _bench_lint() -> dict:
+    """Full-tree piolint pass (predictionio_tpu.analysis — AST only, no
+    imports of linted modules, no jax init). Reporting the rule and
+    finding counts here keeps the static-analysis guard machine-checked
+    the same way every other bench section is: a bench run whose tree
+    has non-baselined findings is flagged in the smoke guard."""
+    t0 = time.perf_counter()
+    from predictionio_tpu.analysis import all_rules, run_lint
+
+    res = run_lint(root=os.path.dirname(os.path.abspath(__file__)))
+    return {
+        "rules": len(all_rules()),
+        "files_scanned": res.files_scanned,
+        "new_findings": len(res.new_findings),
+        "baselined": len(res.baselined),
+        "suppressed": res.suppressed_count,
+        "stale_baseline_entries": res.stale_baseline,
+        "counts_by_code": res.counts_by_code(),
+        "seconds": round(time.perf_counter() - t0, 3),
+    }
+
+
 def main() -> None:
     import jax
 
@@ -1436,6 +1458,7 @@ def main() -> None:
         os.environ["BENCH_RES_OUTAGE_S"] = "2.0"
         os.environ["BENCH_RES_CLIENTS"] = "4"
         os.environ["BENCH_RES_EVENTS"] = "3000"
+        os.environ["BENCH_LINT"] = "1"
         os.environ.pop("BENCH_PRECISION_COMPARE", None)
         # fresh compile cache: a persistent cache populated on a different
         # host can carry AOT results whose CPU features mismatch (SIGILL risk)
@@ -1540,6 +1563,12 @@ def main() -> None:
             detail["resilience"] = _bench_resilience(outage_s, res_clients)
         except Exception as e:
             detail["resilience"] = {"error": str(e)[:300]}
+
+    if os.environ.get("BENCH_LINT", "1") != "0":
+        try:
+            detail["lint"] = _bench_lint()
+        except Exception as e:
+            detail["lint"] = {"error": str(e)[:300]}
 
     print(
         json.dumps(

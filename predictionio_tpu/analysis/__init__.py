@@ -1,0 +1,55 @@
+"""piolint — project-wide AST static analysis for predictionio_tpu.
+
+The three concurrency-heavy host subsystems (serving micro-batcher,
+resilience layer, remote-storage RPC) carry invariants — jax-free
+packages, opt-in defaults, locks held around shared state, deadlines
+propagated — that used to be enforced by bespoke grep/import guards in
+``tests/test_ci_guards.py``. The upstream PredictionIO tree kept itself
+shippable by compiling every module under ``sbt test`` (SURVEY.md §5);
+piolint is the JAX-side analog for a server that must run as fast as the
+hardware allows: a purely syntactic pass that also catches
+dispatch-blocking host syncs and retracing hazards before they ever
+reach a TPU — the class of silent-performance bugs ALX (arxiv
+2112.02194) reports dominating TPU tuning and that DrJAX (arxiv
+2403.07128) avoids by keeping its primitives traceable end to end.
+
+Rule families (docs/development.md):
+
+* ``PIO1xx`` layering — declarative import manifest (:mod:`manifest`)
+* ``PIO2xx`` concurrency — lock scope, blocking-under-lock, lock order
+* ``PIO3xx`` JAX hygiene — host syncs inside jit, mutable jit closures
+* ``PIO4xx`` server hygiene — untimed sockets, bare excepts in handlers
+
+This package is **stdlib-only and never imports the modules it lints**
+(AST text analysis only) — enforced by its own manifest entry, so the
+linter stays runnable in <10 s on CPU-only CI with no jax present.
+"""
+
+from __future__ import annotations
+
+from predictionio_tpu.analysis.engine import (
+    Finding,
+    LintResult,
+    all_rules,
+    lint_file,
+    lint_tree,
+    run_lint,
+)
+from predictionio_tpu.analysis.manifest import DEFAULT_MANIFEST, PackageRule
+
+# importing the rule modules registers their rules with the engine
+from predictionio_tpu.analysis import rules_layering  # noqa: F401  (registry)
+from predictionio_tpu.analysis import rules_concurrency  # noqa: F401
+from predictionio_tpu.analysis import rules_jax  # noqa: F401
+from predictionio_tpu.analysis import rules_server  # noqa: F401
+
+__all__ = [
+    "DEFAULT_MANIFEST",
+    "Finding",
+    "LintResult",
+    "PackageRule",
+    "all_rules",
+    "lint_file",
+    "lint_tree",
+    "run_lint",
+]

@@ -1,0 +1,431 @@
+"""piolint core: rule registry, AST walker, suppressions, baseline.
+
+Everything here is file-local and syntactic: a rule receives one parsed
+module (:class:`FileContext`) and yields :class:`Finding`s. The engine
+owns the cross-cutting mechanics every rule gets for free:
+
+* ``file:line`` diagnostics with stable, line-free messages (so the
+  baseline survives unrelated edits that shift line numbers);
+* inline suppressions — ``# piolint: disable=PIO201`` on the reported
+  line, or ``# piolint: disable-file=PIO301`` anywhere in the file;
+* a checked-in JSON baseline (``piolint-baseline.json`` at the repo
+  root): pre-existing, reviewed findings don't fail CI while any NEW
+  finding does. Baseline entries match on (code, path, message) with a
+  count, never on line numbers.
+
+Stdlib-only by contract (manifest entry for this package): the linter
+parses source text and must never import what it lints.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+from typing import Callable, Iterable, Iterator
+
+from predictionio_tpu.analysis.manifest import DEFAULT_MANIFEST, Manifest
+
+__all__ = [
+    "FileContext",
+    "Finding",
+    "LintResult",
+    "all_rules",
+    "lint_file",
+    "lint_tree",
+    "load_baseline",
+    "rule",
+    "run_lint",
+    "write_baseline",
+]
+
+#: default baseline filename, resolved against the lint root
+BASELINE_NAME = "piolint-baseline.json"
+
+#: directories never descended into by :func:`lint_tree`
+_SKIP_DIRS = frozenset(
+    {
+        "tests", "__pycache__", "docs", "bin", "node_modules",
+        # local tooling/vendored trees a dev checkout commonly grows —
+        # linting third-party code would fail CI on a clean repo
+        "venv", "build", "dist", "site-packages", "__pypackages__",
+    }
+)
+
+_DISABLE_RE = re.compile(r"#\s*piolint:\s*disable=([A-Za-z0-9,\s]+)")
+_DISABLE_FILE_RE = re.compile(r"#\s*piolint:\s*disable-file=([A-Za-z0-9,\s]+)")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One diagnostic. ``path`` is repo-relative posix; ``message`` must
+    be stable across unrelated edits (no line numbers, no volatile
+    state) because the baseline keys on (code, path, message)."""
+
+    code: str
+    path: str
+    line: int
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.code} {self.message}"
+
+    def key(self) -> tuple[str, str, str]:
+        return (self.code, self.path, self.message)
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    code: str
+    name: str
+    description: str
+    check: Callable[["FileContext"], Iterable[Finding]]
+
+
+#: code -> Rule; populated by the :func:`rule` decorator at import time
+_RULES: dict[str, Rule] = {}
+
+
+def rule(code: str, name: str, description: str):
+    """Register a rule function under ``code`` (e.g. ``PIO201``). The
+    function receives a :class:`FileContext` and yields findings; the
+    engine applies suppressions and the baseline afterwards."""
+
+    def deco(fn: Callable[["FileContext"], Iterable[Finding]]):
+        if code in _RULES:
+            raise ValueError(f"duplicate piolint rule code {code}")
+        _RULES[code] = Rule(code, name, description, fn)
+        return fn
+
+    return deco
+
+
+def all_rules() -> dict[str, Rule]:
+    return dict(_RULES)
+
+
+class FileContext:
+    """One parsed module plus the lookups every rule wants.
+
+    ``import_map`` resolves local names to absolute dotted modules —
+    ``import numpy as np`` maps ``np -> numpy``; ``from time import
+    sleep`` maps ``sleep -> time.sleep``; relative imports are resolved
+    against the file's package path so layering rules compare absolute
+    names only.
+    """
+
+    def __init__(self, rel_path: str, source: str, manifest: Manifest):
+        self.rel_path = rel_path.replace(os.sep, "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.manifest = manifest
+        self.tree = ast.parse(source, filename=rel_path)
+        self.import_map = self._build_import_map()
+
+    # -------------------------------------------------------------- imports
+    def package_parts(self) -> list[str]:
+        """Dotted-package parts of this file's directory, e.g.
+        ``predictionio_tpu/serving/batcher.py`` ->
+        ``["predictionio_tpu", "serving"]``."""
+        parts = self.rel_path.split("/")[:-1]
+        return [p for p in parts if p]
+
+    def resolve_relative(self, node: ast.ImportFrom) -> str:
+        """Absolute dotted module for a (possibly relative) ImportFrom."""
+        if node.level == 0:
+            return node.module or ""
+        base = self.package_parts()
+        # level=1 is the current package, each extra level climbs one up
+        up = node.level - 1
+        base = base[: len(base) - up] if up else base
+        mod = ".".join(base)
+        if node.module:
+            mod = f"{mod}.{node.module}" if mod else node.module
+        return mod
+
+    def iter_imports(self) -> Iterator[tuple[ast.AST, str]]:
+        """Yield ``(node, absolute_module)`` for every import statement,
+        including function-local ones (ast.walk)."""
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    yield node, alias.name
+            elif isinstance(node, ast.ImportFrom):
+                yield node, self.resolve_relative(node)
+
+    def _build_import_map(self) -> dict[str, str]:
+        out: dict[str, str] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    local = alias.asname or alias.name.split(".")[0]
+                    target = alias.name if alias.asname else alias.name.split(".")[0]
+                    out[local] = target
+            elif isinstance(node, ast.ImportFrom):
+                mod = self.resolve_relative(node)
+                for alias in node.names:
+                    if alias.name == "*":
+                        continue
+                    local = alias.asname or alias.name
+                    out[local] = f"{mod}.{alias.name}" if mod else alias.name
+        return out
+
+    def dotted_name(self, node: ast.AST) -> str | None:
+        """Absolute dotted name of a Name/Attribute chain, resolved
+        through the import map: with ``import numpy as np``,
+        ``np.asarray`` -> ``numpy.asarray``. None for anything fancier
+        (subscripts, calls)."""
+        parts: list[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        root = self.import_map.get(node.id, node.id)
+        parts.append(root)
+        return ".".join(reversed(parts))
+
+    # -------------------------------------------------------------- helpers
+    def finding(self, code: str, node: ast.AST | int, message: str) -> Finding:
+        line = node if isinstance(node, int) else getattr(node, "lineno", 1)
+        return Finding(code=code, path=self.rel_path, line=line, message=message)
+
+    # --------------------------------------------------------- suppressions
+    def file_suppressions(self) -> set[str]:
+        codes: set[str] = set()
+        for m in _DISABLE_FILE_RE.finditer(self.source):
+            codes.update(c.strip() for c in m.group(1).split(",") if c.strip())
+        return codes
+
+    def line_suppressions(self, line: int) -> set[str]:
+        if 1 <= line <= len(self.lines):
+            m = _DISABLE_RE.search(self.lines[line - 1])
+            if m:
+                return {c.strip() for c in m.group(1).split(",") if c.strip()}
+        return set()
+
+    def is_suppressed(self, f: Finding, _file_codes: set[str] | None = None) -> bool:
+        file_codes = (
+            _file_codes if _file_codes is not None else self.file_suppressions()
+        )
+        if f.code in file_codes or "all" in file_codes:
+            return True
+        line_codes = self.line_suppressions(f.line)
+        return f.code in line_codes or "all" in line_codes
+
+
+# ---------------------------------------------------------------------------
+# Running
+# ---------------------------------------------------------------------------
+
+
+def lint_file(
+    rel_path: str, source: str, manifest: Manifest | None = None
+) -> tuple[list[Finding], int]:
+    """Lint one module. Returns ``(findings, suppressed_count)``; a file
+    that does not parse yields a single ``PIO100`` finding (the parse-all
+    CI guard owns syntax errors, but the linter must not crash)."""
+    manifest = manifest or DEFAULT_MANIFEST
+    try:
+        ctx = FileContext(rel_path, source, manifest)
+    except SyntaxError as e:
+        return (
+            [
+                Finding(
+                    "PIO100",
+                    rel_path.replace(os.sep, "/"),
+                    e.lineno or 1,
+                    "file does not parse",
+                )
+            ],
+            0,
+        )
+    file_codes = ctx.file_suppressions()
+    kept: list[Finding] = []
+    suppressed = 0
+    for r in _RULES.values():
+        for f in r.check(ctx):
+            if ctx.is_suppressed(f, file_codes):
+                suppressed += 1
+            else:
+                kept.append(f)
+    return kept, suppressed
+
+
+def iter_tree_files(root: str) -> Iterator[tuple[str, str]]:
+    """Yield ``(abs_path, rel_path)`` for every lintable ``*.py`` under
+    ``root``, skipping tests, hidden and tooling directories."""
+    root = os.path.abspath(root)
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(
+            d
+            for d in dirnames
+            if d not in _SKIP_DIRS and not d.startswith(".")
+        )
+        for name in sorted(filenames):
+            if not name.endswith(".py"):
+                continue
+            abs_path = os.path.join(dirpath, name)
+            yield abs_path, os.path.relpath(abs_path, root)
+
+
+def lint_tree(
+    root: str, manifest: Manifest | None = None
+) -> tuple[list[Finding], int, int]:
+    """Lint every file under ``root``. Returns
+    ``(findings, files_scanned, suppressed_count)``."""
+    findings: list[Finding] = []
+    files = 0
+    suppressed = 0
+    for abs_path, rel_path in iter_tree_files(root):
+        files += 1
+        with open(abs_path, "r", encoding="utf-8", errors="replace") as fh:
+            source = fh.read()
+        found, sup = lint_file(rel_path, source, manifest)
+        findings.extend(found)
+        suppressed += sup
+    findings.sort(key=lambda f: (f.path, f.line, f.code))
+    return findings, files, suppressed
+
+
+# ---------------------------------------------------------------------------
+# Baseline
+# ---------------------------------------------------------------------------
+
+
+def load_baseline(path: str) -> dict[tuple[str, str, str], dict]:
+    """Baseline file -> ``{(code, path, message): entry}`` where entry
+    keeps ``count`` (how many identical findings are accepted) and the
+    reviewer's ``justification``. A missing file is an empty baseline."""
+    if not os.path.exists(path):
+        return {}
+    with open(path, "r", encoding="utf-8") as fh:
+        data = json.load(fh)
+    out: dict[tuple[str, str, str], dict] = {}
+    for e in data.get("entries", ()):
+        out[(e["code"], e["path"], e["message"])] = {
+            "count": int(e.get("count", 1)),
+            "justification": e.get("justification", ""),
+        }
+    return out
+
+
+def write_baseline(findings: list[Finding], path: str) -> None:
+    """Write ``findings`` as the new baseline, preserving justifications
+    of entries that survive (``pio lint --update-baseline``)."""
+    old = load_baseline(path)
+    counts: dict[tuple[str, str, str], int] = {}
+    for f in findings:
+        counts[f.key()] = counts.get(f.key(), 0) + 1
+    entries = []
+    for (code, fpath, message), count in sorted(counts.items()):
+        prev = old.get((code, fpath, message), {})
+        entries.append(
+            {
+                "code": code,
+                "path": fpath,
+                "message": message,
+                "count": count,
+                "justification": prev.get("justification", "")
+                or "TODO: justify or fix",
+            }
+        )
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump({"version": 1, "entries": entries}, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+
+
+def split_by_baseline(
+    findings: list[Finding], baseline: dict[tuple[str, str, str], dict]
+) -> tuple[list[Finding], list[Finding]]:
+    """Partition into (new, baselined). Each baseline entry absorbs at
+    most ``count`` identical findings — if a rule starts firing MORE
+    times at the same (code, path, message), the extras are new."""
+    budget = {k: v["count"] for k, v in baseline.items()}
+    new: list[Finding] = []
+    old: list[Finding] = []
+    for f in findings:
+        k = f.key()
+        if budget.get(k, 0) > 0:
+            budget[k] -= 1
+            old.append(f)
+        else:
+            new.append(f)
+    return new, old
+
+
+# ---------------------------------------------------------------------------
+# Public entry point (pio lint, bench --smoke, tier-1 test)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class LintResult:
+    root: str
+    files_scanned: int
+    new_findings: list[Finding]
+    baselined: list[Finding]
+    suppressed_count: int
+    stale_baseline: int  # baseline entries no current finding matched
+
+    @property
+    def ok(self) -> bool:
+        return not self.new_findings
+
+    def counts_by_code(self) -> dict[str, int]:
+        out: dict[str, int] = {}
+        for f in self.new_findings + self.baselined:
+            out[f.code] = out.get(f.code, 0) + 1
+        return dict(sorted(out.items()))
+
+    def to_json(self) -> dict:
+        return {
+            "ok": self.ok,
+            "filesScanned": self.files_scanned,
+            "rules": len(_RULES),
+            "newFindings": [dataclasses.asdict(f) for f in self.new_findings],
+            "baselinedCount": len(self.baselined),
+            "suppressedCount": self.suppressed_count,
+            "staleBaselineEntries": self.stale_baseline,
+            "countsByCode": self.counts_by_code(),
+        }
+
+
+def default_root() -> str:
+    """The repo root when running from a checkout: the parent of the
+    ``predictionio_tpu`` package directory."""
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return os.path.dirname(pkg)
+
+
+def run_lint(
+    root: str | None = None,
+    baseline_path: str | None = None,
+    update_baseline: bool = False,
+    manifest: Manifest | None = None,
+) -> LintResult:
+    """Lint the tree under ``root`` against the checked-in baseline.
+
+    ``update_baseline=True`` rewrites the baseline file to exactly the
+    current findings (preserving justifications) and reports them all as
+    baselined — the follow-up commit review supplies the justifications.
+    """
+    root = os.path.abspath(root or default_root())
+    baseline_path = baseline_path or os.path.join(root, BASELINE_NAME)
+    findings, files, suppressed = lint_tree(root, manifest)
+    if update_baseline:
+        write_baseline(findings, baseline_path)
+    baseline = load_baseline(baseline_path)
+    new, old = split_by_baseline(findings, baseline)
+    matched_keys = {f.key() for f in old}
+    stale = sum(1 for k in baseline if k not in matched_keys)
+    return LintResult(
+        root=root,
+        files_scanned=files,
+        new_findings=new,
+        baselined=old,
+        suppressed_count=suppressed,
+        stale_baseline=stale,
+    )

@@ -1,0 +1,131 @@
+"""Declarative layering manifest — which package may import what.
+
+This replaces the hand-rolled import scans that used to live in
+``tests/test_ci_guards.py`` (one bespoke ast walk per invariant) with
+one table the ``PIO1xx`` rules read. The guards now assert two things:
+the manifest still DECLARES each contract (so a contract cannot be
+silently dropped) and the tree SATISFIES it (via the linter).
+
+Contract kinds:
+
+* ``forbid`` — absolute module prefixes the package must never import,
+  at top level or function-locally (``jax`` in host-side packages, upper
+  layers from lower ones);
+* ``stdlib_only`` — only stdlib + ``allow``-listed prefixes may be
+  imported (the resilience layer, and this analysis package itself: the
+  linter must never import what it lints);
+* ``sibling_isolation`` — direct subpackages must not import each other
+  (engine templates stay copy-out-able); shared helper MODULES directly
+  under the package (``templates/serving_util.py``) are fine.
+
+Matching is by repo-relative path prefix; the most specific (longest)
+``package`` entry wins for ``forbid``/``stdlib_only`` so a subpackage
+can tighten its parent's contract.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterable
+
+__all__ = ["DEFAULT_MANIFEST", "Manifest", "PackageRule", "rules_for"]
+
+
+@dataclasses.dataclass(frozen=True)
+class PackageRule:
+    #: repo-relative posix directory prefix, e.g. "predictionio_tpu/serving"
+    package: str
+    #: absolute dotted module prefixes this package must never import
+    forbid: tuple[str, ...] = ()
+    #: only stdlib + ``allow`` prefixes may be imported
+    stdlib_only: bool = False
+    #: dotted prefixes exempt from ``stdlib_only``, or — under
+    #: ``sibling_isolation`` — the shared helper modules directly under
+    #: the package that siblings MAY import
+    allow: tuple[str, ...] = ()
+    #: direct subpackages must not import one another
+    sibling_isolation: bool = False
+    #: one-line rationale, surfaced in diagnostics
+    reason: str = ""
+
+
+Manifest = tuple[PackageRule, ...]
+
+
+DEFAULT_MANIFEST: Manifest = (
+    PackageRule(
+        package="predictionio_tpu/serving",
+        forbid=(
+            "jax",
+            "numpy",
+            "predictionio_tpu.workflow",
+            "predictionio_tpu.controller",
+            "predictionio_tpu.ops",
+        ),
+        reason="the micro-batcher is host-side orchestration; device work "
+        "stays behind QueryService.handle_batch and the workflow layer "
+        "imports serving, never the reverse",
+    ),
+    PackageRule(
+        package="predictionio_tpu/resilience",
+        stdlib_only=True,
+        allow=("predictionio_tpu.resilience",),
+        reason="failure policy must wrap any transport (including the "
+        "storage registry, which imports it) without cycles or "
+        "accelerator coupling",
+    ),
+    PackageRule(
+        package="predictionio_tpu/analysis",
+        stdlib_only=True,
+        allow=("predictionio_tpu.analysis",),
+        reason="the linter parses source text and must never import what "
+        "it lints — AST only keeps full-tree CI lint under 10 s with no "
+        "jax initialization",
+    ),
+    PackageRule(
+        package="predictionio_tpu/data",
+        forbid=(
+            "predictionio_tpu.workflow",
+            "predictionio_tpu.tools",
+            "predictionio_tpu.templates",
+            "predictionio_tpu.serving",
+        ),
+        reason="data/storage is the bottom layer: workflow and tools sit "
+        "on top of it",
+    ),
+    PackageRule(
+        package="predictionio_tpu/templates",
+        sibling_isolation=True,
+        allow=("serving_util", "columnar_util", "results"),
+        reason="a template must stay copy-out-able as a standalone engine "
+        "(`pio template get`); shared code belongs in a helper module "
+        "directly under templates/",
+    ),
+)
+
+
+def rules_for(rel_path: str, manifest: Manifest) -> list[PackageRule]:
+    """Manifest entries whose package prefix contains ``rel_path``,
+    most specific first."""
+    rel = rel_path.replace("\\", "/")
+    hits = [r for r in manifest if rel.startswith(r.package + "/")]
+    hits.sort(key=lambda r: len(r.package), reverse=True)
+    return hits
+
+
+def find_rule(manifest: Manifest, package: str) -> PackageRule | None:
+    for r in manifest:
+        if r.package == package:
+            return r
+    return None
+
+
+def is_stdlib(module: str, extra_allowed: Iterable[str] = ()) -> bool:
+    import sys
+
+    top = module.split(".")[0]
+    if top in sys.stdlib_module_names:
+        return True
+    return any(
+        module == p or module.startswith(p + ".") for p in extra_allowed
+    )

@@ -1,0 +1,227 @@
+"""PIO3xx — JAX hygiene rules, scoped to the device-facing packages.
+
+Scope: ``predictionio_tpu/ops/`` and ``predictionio_tpu/parallel/``
+only — the rest of the tree is host-side and its manifest entries keep
+jax out entirely (PIO101/102).
+
+The failure class here is silent performance loss, not crashes: a
+``.item()`` or ``np.asarray`` inside a jitted function forces a device
+sync (or a trace-time constant-fold) on every call, and a jit closing
+over a mutable module global bakes stale state into the compiled
+program — the bugs ALX (arxiv 2112.02194) reports dominating TPU
+matrix-factorization tuning. DrJAX (arxiv 2403.07128) avoids them by
+keeping every primitive traceable end to end; these rules make the same
+property checkable here:
+
+* ``PIO301`` host sync inside jit: ``.item()``, ``np.asarray``/
+  ``np.array``, ``jax.device_get``, ``.block_until_ready()`` or
+  ``float(param)``/``int(param)`` on a traced parameter, inside a
+  ``@jax.jit``/``pjit``-decorated function or one of its local helpers.
+* ``PIO302`` jit closes over a mutable module global (list/dict/set):
+  the traced value is frozen at first compile; later mutation silently
+  diverges from the compiled program.
+* ``PIO303`` unhashable static arg spec: ``static_argnums``/
+  ``static_argnames`` given a list/set/dict literal — jit requires
+  hashable statics; pass a tuple.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from predictionio_tpu.analysis.engine import FileContext, Finding, rule
+
+_SCOPE_PREFIXES = ("predictionio_tpu/ops/", "predictionio_tpu/parallel/")
+
+#: dotted callables that synchronize host and device
+_HOST_SYNC_CALLS = frozenset(
+    {
+        "numpy.asarray",
+        "numpy.array",
+        "jax.device_get",
+    }
+)
+
+_JIT_NAMES = frozenset({"jax.jit", "jax.pjit", "pjit", "jit"})
+
+
+def _in_scope(ctx: FileContext) -> bool:
+    return ctx.rel_path.startswith(_SCOPE_PREFIXES)
+
+
+def _is_jit_expr(ctx: FileContext, node: ast.AST) -> bool:
+    """Is this expression jax.jit / pjit (possibly via functools.partial
+    or a direct call like ``jax.jit(...)``)?"""
+    dotted = ctx.dotted_name(node)
+    if dotted in _JIT_NAMES:
+        return True
+    if isinstance(node, ast.Call):
+        fn = ctx.dotted_name(node.func)
+        if fn in _JIT_NAMES:
+            return True
+        if fn in ("functools.partial", "partial") and node.args:
+            return _is_jit_expr(ctx, node.args[0])
+    return False
+
+
+def _jitted_functions(ctx: FileContext) -> Iterator[ast.FunctionDef]:
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if any(_is_jit_expr(ctx, d) for d in node.decorator_list):
+                yield node
+
+
+def _param_names(fn: ast.FunctionDef) -> set[str]:
+    a = fn.args
+    names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return set(names)
+
+
+@rule(
+    "PIO301",
+    "host-sync-in-jit",
+    "host-synchronizing call inside a jit-decorated function",
+)
+def check_host_sync(ctx: FileContext) -> Iterator[Finding]:
+    if not _in_scope(ctx):
+        return
+    for fn in _jitted_functions(ctx):
+        params = _param_names(fn)
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            # numpy/device_get style calls
+            dotted = ctx.dotted_name(node.func)
+            if dotted in _HOST_SYNC_CALLS:
+                yield ctx.finding(
+                    "PIO301",
+                    node,
+                    f"{dotted}() inside jitted '{fn.name}' forces a "
+                    "host sync / trace-time constant; use jnp instead",
+                )
+                continue
+            # .item() / .block_until_ready() method calls
+            if isinstance(node.func, ast.Attribute) and node.func.attr in (
+                "item",
+                "block_until_ready",
+            ):
+                yield ctx.finding(
+                    "PIO301",
+                    node,
+                    f".{node.func.attr}() inside jitted '{fn.name}' "
+                    "blocks dispatch on a device round trip",
+                )
+                continue
+            # float(x)/int(x)/bool(x) on a traced parameter
+            if (
+                isinstance(node.func, ast.Name)
+                and node.func.id in ("float", "int", "bool")
+                and len(node.args) == 1
+                and isinstance(node.args[0], ast.Name)
+                and node.args[0].id in params
+            ):
+                yield ctx.finding(
+                    "PIO301",
+                    node,
+                    f"{node.func.id}({node.args[0].id}) on a parameter of "
+                    f"jitted '{fn.name}' forces a concrete value "
+                    "(TracerConversion / silent recompile)",
+                )
+
+
+def _mutable_module_globals(tree: ast.Module) -> dict[str, int]:
+    """Module-level names bound to mutable literals (list/dict/set or
+    their constructor calls) -> first assignment line."""
+    out: dict[str, int] = {}
+    for stmt in tree.body:
+        targets: list[ast.AST] = []
+        if isinstance(stmt, ast.Assign):
+            targets = list(stmt.targets)
+            value = stmt.value
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            targets = [stmt.target]
+            value = stmt.value
+        else:
+            continue
+        mutable = isinstance(value, (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp, ast.SetComp)) or (
+            isinstance(value, ast.Call)
+            and isinstance(value.func, ast.Name)
+            and value.func.id in ("list", "dict", "set", "bytearray", "defaultdict", "Counter", "deque")
+        )
+        if not mutable:
+            continue
+        for t in targets:
+            if isinstance(t, ast.Name):
+                out.setdefault(t.id, stmt.lineno)
+    return out
+
+
+@rule(
+    "PIO302",
+    "jit-mutable-global",
+    "jit-decorated function reads a mutable module global",
+)
+def check_mutable_closure(ctx: FileContext) -> Iterator[Finding]:
+    if not _in_scope(ctx):
+        return
+    mutables = _mutable_module_globals(ctx.tree)
+    if not mutables:
+        return
+    for fn in _jitted_functions(ctx):
+        local = _param_names(fn)
+        # names assigned anywhere in the function shadow the global
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+                local.add(node.id)
+        for node in ast.walk(fn):
+            if (
+                isinstance(node, ast.Name)
+                and isinstance(node.ctx, ast.Load)
+                and node.id in mutables
+                and node.id not in local
+            ):
+                yield ctx.finding(
+                    "PIO302",
+                    node,
+                    f"jitted '{fn.name}' closes over mutable module "
+                    f"global '{node.id}': its value is frozen at trace "
+                    "time and later mutation silently diverges",
+                )
+                break  # one report per function is enough to act on
+
+
+@rule(
+    "PIO303",
+    "unhashable-static-args",
+    "static_argnums/static_argnames given an unhashable literal",
+)
+def check_static_args(ctx: FileContext) -> Iterator[Finding]:
+    if not _in_scope(ctx):
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = ctx.dotted_name(node.func)
+        is_jitcall = fn in _JIT_NAMES or (
+            fn in ("functools.partial", "partial")
+            and node.args
+            and _is_jit_expr(ctx, node.args[0])
+        )
+        if not is_jitcall:
+            continue
+        for kw in node.keywords:
+            if kw.arg in ("static_argnums", "static_argnames") and isinstance(
+                kw.value, (ast.List, ast.Set, ast.Dict)
+            ):
+                yield ctx.finding(
+                    "PIO303",
+                    kw.value,
+                    f"{kw.arg} must be hashable — use a tuple, not a "
+                    f"{type(kw.value).__name__.lower()} literal "
+                    "(jit raises at call time, or retraces per call)",
+                )

@@ -1,0 +1,75 @@
+"""PIO4xx — server hygiene rules.
+
+A server that must hold p99 under load cannot afford a single untimed
+socket: one hung dependency pins a handler thread forever, and the
+convoy takes the listener down long before any error is logged. The
+resilience layer (docs/operations.md) exists to bound exactly this, so
+these rules police the rest of the tree:
+
+* ``PIO401`` untimed network call: ``urllib.request.urlopen``,
+  ``socket.create_connection`` or an ``http.client`` connection without
+  an explicit ``timeout=`` — outside ``resilience/`` (whose wrappers are
+  the sanctioned place for timeout policy).
+* ``PIO402`` bare ``except:`` in server-side code: swallows
+  ``KeyboardInterrupt``/``SystemExit`` and turns shutdown into a hang;
+  HTTP handlers must catch ``Exception`` at the broadest.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from predictionio_tpu.analysis.engine import FileContext, Finding, rule
+
+#: network entry points that accept a timeout= keyword
+_TIMED_CALLS = frozenset(
+    {
+        "urllib.request.urlopen",
+        "socket.create_connection",
+        "http.client.HTTPConnection",
+        "http.client.HTTPSConnection",
+    }
+)
+
+_EXEMPT_PREFIXES = ("predictionio_tpu/resilience/", "predictionio_tpu/analysis/")
+
+
+@rule(
+    "PIO401",
+    "untimed-network-call",
+    "socket/urlopen call without an explicit timeout= keyword",
+)
+def check_untimed_sockets(ctx: FileContext) -> Iterator[Finding]:
+    if ctx.rel_path.startswith(_EXEMPT_PREFIXES):
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        dotted = ctx.dotted_name(node.func)
+        if dotted not in _TIMED_CALLS:
+            continue
+        if not any(k.arg == "timeout" for k in node.keywords):
+            yield ctx.finding(
+                "PIO401",
+                node,
+                f"{dotted}() without timeout= — a hung peer pins this "
+                "thread forever (resilience/ wrappers are the sanctioned "
+                "timeout policy layer)",
+            )
+
+
+@rule(
+    "PIO402",
+    "bare-except",
+    "bare `except:` in server-side code",
+)
+def check_bare_except(ctx: FileContext) -> Iterator[Finding]:
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.ExceptHandler) and node.type is None:
+            yield ctx.finding(
+                "PIO402",
+                node,
+                "bare `except:` swallows KeyboardInterrupt/SystemExit; "
+                "catch Exception at the broadest",
+            )

@@ -52,6 +52,7 @@ from __future__ import annotations
 import dataclasses
 import functools
 import logging
+import types
 from typing import Any, NamedTuple, Sequence
 
 import jax
@@ -90,11 +91,14 @@ _DEFAULT_CHUNK_ENTRIES = 1 << 22
 #: [chunk_entries/width, K, K] solve buffer far bigger than the gather.
 _DEFAULT_CHUNK_ROWS = 1 << 15
 
-_PRECISIONS = {
+# read-only: als_sweep (jit) closes over this table, so a mutable dict
+# here would be frozen into the compiled program at trace time (piolint
+# PIO302) — the proxy makes the immutability the trace assumes explicit
+_PRECISIONS = types.MappingProxyType({
     "default": jax.lax.Precision.DEFAULT,
     "high": jax.lax.Precision.HIGH,
     "highest": jax.lax.Precision.HIGHEST,
-}
+})
 
 
 @dataclasses.dataclass(frozen=True)

@@ -177,6 +177,12 @@ class MicroBatcher:
         self._queue: "queue.Queue[_Pending | None]" = queue.Queue(
             maxsize=self.config.max_queue
         )
+        # guards writes to _closed (shared with submit() on HTTP handler
+        # threads; piolint PIO201 keeps every post-__init__ write under
+        # it). Readers stay lock-free on purpose: the submit/close race
+        # is resolved by submit()'s post-enqueue re-check plus the
+        # idempotent _drain_dead_queue(), not by mutual exclusion
+        self._lock = threading.Lock()
         self._closed = False
         if self.config.warmup_body is not None:
             self.warmup(self.config.warmup_body)
@@ -304,7 +310,8 @@ class MicroBatcher:
     def close(self) -> None:
         """Stop the dispatcher. Requests already being drained are
         answered normally; anything still queued (or racing in) gets 503."""
-        self._closed = True
+        with self._lock:
+            self._closed = True
         self._queue.put(None)  # wake the dispatcher even when idle
         self._thread.join(timeout=5.0)
         # a submit() that passed its _closed check concurrently with this

@@ -35,7 +35,7 @@ from predictionio_tpu.controller import (
 from predictionio_tpu.data.aggregator import BiMap
 from predictionio_tpu.data.store import LEventStore, PEventStore
 from predictionio_tpu.ops.als import ALSConfig, train_als
-from predictionio_tpu.templates.recommendation.engine import ItemScore, PredictedResult
+from predictionio_tpu.templates.results import ItemScore, PredictedResult
 
 __all__ = [
     "Query",

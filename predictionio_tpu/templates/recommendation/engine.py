@@ -36,6 +36,9 @@ from predictionio_tpu.controller import (
 from predictionio_tpu.data.aggregator import BiMap
 from predictionio_tpu.data.store import PEventStore
 from predictionio_tpu.templates.serving_util import TOPK_CHUNK
+# re-exported (see __all__): the ranked-result wire types are shared by
+# the similarproduct and ecommerce templates via templates/results.py
+from predictionio_tpu.templates.results import ItemScore, PredictedResult
 from predictionio_tpu.ops.als import ALSConfig, top_k_items, train_als
 
 __all__ = [
@@ -64,12 +67,6 @@ class Query:
 
 
 @dataclasses.dataclass(frozen=True)
-class ItemScore:
-    item: str
-    score: float
-
-
-@dataclasses.dataclass(frozen=True)
 class Actual:
     """Ground truth for one eval query: held-out positive items plus the
     items the user already rated in the training split (skipped — not
@@ -77,16 +74,6 @@ class Actual:
 
     items: tuple = ()
     seen: tuple = ()
-
-
-@dataclasses.dataclass(frozen=True)
-class PredictedResult:
-    item_scores: tuple = ()
-
-    def to_json(self) -> dict[str, Any]:
-        return {
-            "itemScores": [{"item": s.item, "score": s.score} for s in self.item_scores]
-        }
 
 
 # ---------------------------------------------------------------- datasource

@@ -1,0 +1,39 @@
+"""Shared ranked-result wire types for the item-ranking templates.
+
+``recommendation``, ``similarproduct`` and ``ecommerce`` all answer
+queries with the same reference wire shape::
+
+    {"itemScores": [{"item": "i1", "score": 4.2}, ...]}
+
+These dataclasses used to live in ``recommendation/engine.py`` and the
+other two templates imported them from there — a template-to-template
+dependency that breaks the copy-out contract of ``pio template get``
+(and is now rejected by piolint's sibling-isolation rule, PIO103).
+Shared helper modules directly under ``templates/`` are the sanctioned
+home for cross-template code (see ``serving_util``/``columnar_util``);
+``recommendation/engine.py`` re-exports both names so existing engine
+code and tests keep working.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+__all__ = ["ItemScore", "PredictedResult"]
+
+
+@dataclasses.dataclass(frozen=True)
+class ItemScore:
+    item: str
+    score: float
+
+
+@dataclasses.dataclass(frozen=True)
+class PredictedResult:
+    item_scores: tuple = ()
+
+    def to_json(self) -> dict[str, Any]:
+        return {
+            "itemScores": [{"item": s.item, "score": s.score} for s in self.item_scores]
+        }

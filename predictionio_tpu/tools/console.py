@@ -327,6 +327,32 @@ def build_parser() -> argparse.ArgumentParser:
         help="command and arguments (e.g. `pio run python myscript.py`)",
     )
 
+    # ---- lint (piolint: predictionio_tpu.analysis; docs/development.md)
+    lint = sub.add_parser(
+        "lint",
+        help="run piolint — AST layering/concurrency/JAX-hygiene analysis "
+        "over the source tree (exits 1 on any non-baselined finding)",
+    )
+    lint.add_argument(
+        "--root", default=None,
+        help="tree to lint (default: this checkout's repo root)",
+    )
+    lint.add_argument(
+        "--format", choices=("text", "json"), default="text",
+        help="text = file:line diagnostics; json = machine-readable "
+        "summary + findings",
+    )
+    lint.add_argument(
+        "--baseline", default=None, metavar="FILE",
+        help="baseline file (default: <root>/piolint-baseline.json)",
+    )
+    lint.add_argument(
+        "--update-baseline", action="store_true",
+        help="rewrite the baseline to the current findings (keeps "
+        "existing justifications); add one-line justifications before "
+        "committing",
+    )
+
     # ---- upgrade (informational parity stub)
     sub.add_parser(
         "upgrade",
@@ -659,6 +685,35 @@ def main(argv: list[str] | None = None) -> int:
                 repo_root + os.pathsep + env.get("PYTHONPATH", "")
             ).rstrip(os.pathsep)
             return subprocess.run(cmdline, env=env).returncode
+        elif cmd == "lint":
+            # stdlib-only AST analysis: imports nothing it lints, never
+            # initializes jax — safe and fast on any CI host
+            from predictionio_tpu.analysis import run_lint
+
+            res = run_lint(
+                root=args.root,
+                baseline_path=args.baseline,
+                update_baseline=args.update_baseline,
+            )
+            if args.format == "json":
+                print(json.dumps(res.to_json(), indent=2))
+            else:
+                for f in res.new_findings:
+                    print(f.render())
+                summary = (
+                    f"piolint: {res.files_scanned} files, "
+                    f"{len(res.new_findings)} new finding(s), "
+                    f"{len(res.baselined)} baselined, "
+                    f"{res.suppressed_count} suppressed"
+                )
+                if res.stale_baseline:
+                    summary += (
+                        f", {res.stale_baseline} stale baseline entr"
+                        f"{'y' if res.stale_baseline == 1 else 'ies'} "
+                        "(fixed findings — prune with --update-baseline)"
+                    )
+                print(summary)
+            return 0 if res.ok else 1
         elif cmd == "upgrade":
             print(
                 "predictionio_tpu is a Python package: upgrade with your "

@@ -55,60 +55,52 @@ def test_every_tracked_python_file_parses():
     assert "__graft_entry__.py" in tracked
 
 
-def test_serving_runtime_is_accelerator_free():
-    """The micro-batching serving runtime (predictionio_tpu/serving/) is
-    host-side orchestration and must run under JAX_PLATFORMS=cpu without
-    ever touching an accelerator: no module in the package may import
-    jax (the device work stays behind QueryService.handle_batch, which
-    the engines gate themselves). An ast walk catches both top-level and
-    function-local imports."""
-    pkg = os.path.join(REPO, "predictionio_tpu", "serving")
-    offenders = []
-    for name in sorted(os.listdir(pkg)):
-        if not name.endswith(".py"):
-            continue
-        with open(os.path.join(pkg, name), "rb") as fh:
-            tree = ast.parse(fh.read(), filename=name)
-        for node in ast.walk(tree):
-            if isinstance(node, ast.Import):
-                for alias in node.names:
-                    if alias.name == "jax" or alias.name.startswith("jax."):
-                        offenders.append(f"{name}:{node.lineno}")
-            elif isinstance(node, ast.ImportFrom):
-                mod = node.module or ""
-                if mod == "jax" or mod.startswith("jax."):
-                    offenders.append(f"{name}:{node.lineno}")
-    assert not offenders, f"serving runtime imports jax: {offenders}"
+def test_layering_contracts_declared_and_satisfied():
+    """The jax-free / stdlib-only package contracts used to live here as
+    hand-rolled ast import scans (one bespoke walk per invariant). They
+    are now owned by piolint's declarative layering manifest
+    (``predictionio_tpu/analysis/manifest.py``, rules PIO101/PIO102) —
+    this guard asserts both halves of that migration:
 
+    1. the manifest still DECLARES each contract (so an edit cannot
+       silently drop the serving-jax-free or resilience-stdlib-only
+       invariants while the lint keeps passing vacuously), and
+    2. the tree SATISFIES them: zero PIO1xx findings in those packages,
+       baseline or not — layering violations are never baselinable debt.
+    """
+    from predictionio_tpu.analysis import DEFAULT_MANIFEST, run_lint
+    from predictionio_tpu.analysis.manifest import find_rule
 
-def test_resilience_package_is_stdlib_only_and_jax_free():
-    """predictionio_tpu/resilience/ is host-side failure policy and must
-    stay dependency-free: stdlib imports only (no jax, no numpy, no
-    framework layers) so it can wrap any transport — including the
-    storage registry, which imports it — without cycles or accelerator
-    coupling. An ast walk catches top-level and function-local imports."""
-    pkg = os.path.join(REPO, "predictionio_tpu", "resilience")
-    offenders = []
-    for name in sorted(os.listdir(pkg)):
-        if not name.endswith(".py"):
-            continue
-        with open(os.path.join(pkg, name), "rb") as fh:
-            tree = ast.parse(fh.read(), filename=name)
-        for node in ast.walk(tree):
-            mods = []
-            if isinstance(node, ast.Import):
-                mods = [alias.name for alias in node.names]
-            elif isinstance(node, ast.ImportFrom):
-                if node.level >= 1:
-                    continue  # relative import: intra-package by definition
-                mods = [node.module or ""]
-            for mod in mods:
-                top = mod.split(".")[0]
-                if mod.startswith("predictionio_tpu.resilience"):
-                    continue  # intra-package imports are fine
-                if top not in sys.stdlib_module_names:
-                    offenders.append(f"{name}:{node.lineno}: {mod}")
-    assert not offenders, f"non-stdlib imports in resilience pkg: {offenders}"
+    serving = find_rule(DEFAULT_MANIFEST, "predictionio_tpu/serving")
+    assert serving is not None and "jax" in serving.forbid, (
+        "manifest no longer forbids jax in predictionio_tpu/serving"
+    )
+    resilience = find_rule(DEFAULT_MANIFEST, "predictionio_tpu/resilience")
+    assert resilience is not None and resilience.stdlib_only, (
+        "manifest no longer marks predictionio_tpu/resilience stdlib-only"
+    )
+    analysis = find_rule(DEFAULT_MANIFEST, "predictionio_tpu/analysis")
+    assert analysis is not None and analysis.stdlib_only, (
+        "manifest no longer marks the linter itself stdlib-only — the "
+        "linter must never import what it lints"
+    )
+
+    res = run_lint(root=REPO)
+    layering = [
+        f
+        for f in res.new_findings + res.baselined
+        if f.code.startswith("PIO1")
+        and f.path.startswith(
+            (
+                "predictionio_tpu/serving/",
+                "predictionio_tpu/resilience/",
+                "predictionio_tpu/analysis/",
+            )
+        )
+    ]
+    assert not layering, "layering violations:\n" + "\n".join(
+        f.render() for f in layering
+    )
 
 
 def test_resilience_defaults_are_do_nothing():
@@ -234,3 +226,12 @@ def test_bench_smoke_runs_green():
     assert res["breaker"]["opened_count"] >= 1
     assert res["breaker"]["state_after_recovery"] == "closed"
     assert res["degraded_after_recovery"] is False
+    # static-analysis section (ISSUE 3): the bench reports piolint rule
+    # and finding counts so the guard output stays machine-checked — a
+    # tree with non-baselined findings cannot produce a green smoke
+    lint = detail.get("lint")
+    assert lint is not None, "missing bench section 'lint'"
+    assert "error" not in lint, f"lint errored: {lint}"
+    assert lint["rules"] >= 6
+    assert lint["files_scanned"] > 50
+    assert lint["new_findings"] == 0, f"non-baselined lint findings: {lint}"

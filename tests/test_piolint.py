@@ -1,0 +1,530 @@
+"""piolint (predictionio_tpu.analysis) — fixture tests per rule, the
+suppression / baseline mechanics, the ``pio lint`` CLI contract, and the
+tier-1 full-tree lint gate.
+
+Every rule gets three fixture flavors where meaningful: a positive
+snippet that must fire, the same snippet with an inline suppression
+(must not fire), and a baseline exclusion (fires but is not "new").
+The fixtures are synthetic sources linted under synthetic repo-relative
+paths — the engine never imports what it lints, so no fixture is ever
+executed.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+from predictionio_tpu.analysis import run_lint
+from predictionio_tpu.analysis.engine import (
+    Finding,
+    lint_file,
+    load_baseline,
+    split_by_baseline,
+    write_baseline,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _codes(rel_path: str, source: str) -> list[str]:
+    found, _ = lint_file(rel_path, textwrap.dedent(source))
+    return [f.code for f in found]
+
+
+def _find(rel_path: str, source: str) -> list[Finding]:
+    found, _ = lint_file(rel_path, textwrap.dedent(source))
+    return found
+
+
+# ---------------------------------------------------------------------------
+# PIO1xx layering
+# ---------------------------------------------------------------------------
+
+
+def test_pio101_forbidden_import_fires_and_suppresses():
+    src = "import jax\n"
+    assert _codes("predictionio_tpu/serving/x.py", src) == ["PIO101"]
+    # function-local imports are caught too (the old guard's property)
+    local = """\
+    def f():
+        from jax import numpy
+    """
+    assert "PIO101" in _codes("predictionio_tpu/serving/x.py", local)
+    # outside the manifested package the same import is fine
+    assert _codes("predictionio_tpu/ops/x.py", src) == []
+    suppressed = "import jax  # piolint: disable=PIO101\n"
+    assert _codes("predictionio_tpu/serving/x.py", suppressed) == []
+
+
+def test_pio102_stdlib_only_package():
+    assert _codes("predictionio_tpu/resilience/x.py", "import numpy\n") == [
+        "PIO102"
+    ]
+    assert _codes("predictionio_tpu/resilience/x.py", "import json\n") == []
+    # intra-package imports are allow-listed
+    ok = "from predictionio_tpu.resilience.retry import RetryPolicy\n"
+    assert _codes("predictionio_tpu/resilience/x.py", ok) == []
+    # relative imports resolve to the package and stay allowed
+    assert _codes("predictionio_tpu/resilience/x.py", "from . import retry\n") == []
+
+
+def test_pio103_template_sibling_isolation():
+    bad = "from predictionio_tpu.templates.bar.engine import Model\n"
+    assert _codes("predictionio_tpu/templates/foo/engine.py", bad) == ["PIO103"]
+    # bare package-root imports of a sibling are violations too
+    bare = "from predictionio_tpu.templates.bar import engine_factory\n"
+    assert _codes("predictionio_tpu/templates/foo/engine.py", bare) == ["PIO103"]
+    # shared helper modules directly under templates/ are sanctioned
+    ok = "from predictionio_tpu.templates.serving_util import chunked_topk\n"
+    assert _codes("predictionio_tpu/templates/foo/engine.py", ok) == []
+    shared_results = "from predictionio_tpu.templates.results import ItemScore\n"
+    assert _codes("predictionio_tpu/templates/foo/engine.py", shared_results) == []
+    # a helper module itself (not inside a template dir) may import freely
+    assert _codes("predictionio_tpu/templates/serving_util.py", bad) == []
+
+
+# ---------------------------------------------------------------------------
+# PIO2xx concurrency
+# ---------------------------------------------------------------------------
+
+_LOCKED_CLASS = """\
+import threading
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0  # __init__ is exempt: not shared yet
+
+    def good(self):
+        with self._lock:
+            self._count += 1
+
+    def bad(self):
+        self._count += 1
+"""
+
+
+def test_pio201_unguarded_shared_write():
+    found = _find("predictionio_tpu/x.py", _LOCKED_CLASS)
+    assert [f.code for f in found] == ["PIO201"]
+    assert "_count" in found[0].message and "C" in found[0].message
+    # a class with no lock is out of contract — nothing fires
+    lockless = _LOCKED_CLASS.replace("self._lock = threading.Lock()", "pass")
+    assert _codes("predictionio_tpu/x.py", lockless) == []
+    # suppression on the reported line
+    suppressed = _LOCKED_CLASS.replace(
+        "        self._count += 1\n\n    def bad",
+        "        self._count += 1\n\n    def bad",
+    ).replace(
+        "    def bad(self):\n        self._count += 1",
+        "    def bad(self):\n        self._count += 1  # piolint: disable=PIO201",
+    )
+    assert _codes("predictionio_tpu/x.py", suppressed) == []
+
+
+def test_pio201_from_import_lock_and_deferred_writes():
+    # `from threading import Lock` declares a lock all the same
+    from_import = """\
+    from threading import Lock
+
+    class C:
+        def __init__(self):
+            self._lock = Lock()
+
+        def bad(self):
+            self._n = 1
+    """
+    assert _codes("predictionio_tpu/x.py", from_import) == ["PIO201"]
+    # a function DEFINED under the lock does not necessarily RUN under
+    # it — its writes are not guarded by the enclosing with
+    deferred = """\
+    import threading
+
+    class C:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def go(self):
+            with self._lock:
+                def later():
+                    self._x = 1
+                return later
+    """
+    assert _codes("predictionio_tpu/x.py", deferred) == ["PIO201"]
+
+
+def test_pio202_blocking_call_under_lock():
+    src = """\
+    import threading
+    import time
+
+    class C:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def bad(self):
+            with self._lock:
+                time.sleep(1.0)
+
+        def good(self):
+            time.sleep(1.0)
+    """
+    assert _codes("predictionio_tpu/x.py", src) == ["PIO202"]
+    # resolved through the import map: `from time import sleep`
+    aliased = """\
+    import threading
+    from time import sleep
+
+    _lock = threading.Lock()
+
+    def bad():
+        with _lock:
+            sleep(1.0)
+    """
+    assert _codes("predictionio_tpu/x.py", aliased) == ["PIO202"]
+    # a function DEFINED under the lock does not RUN under it
+    deferred = """\
+    import threading
+    import time
+
+    _lock = threading.Lock()
+
+    def f():
+        with _lock:
+            def cb():
+                time.sleep(1.0)
+            return cb
+    """
+    assert _codes("predictionio_tpu/x.py", deferred) == []
+
+
+def test_pio203_lock_order_cycle():
+    src = """\
+    import threading
+
+    class C:
+        def __init__(self):
+            self._a_lock = threading.Lock()
+            self._b_lock = threading.Lock()
+
+        def one(self):
+            with self._a_lock:
+                with self._b_lock:
+                    pass
+
+        def two(self):
+            with self._b_lock:
+                with self._a_lock:
+                    pass
+    """
+    found = _find("predictionio_tpu/x.py", src)
+    assert [f.code for f in found] == ["PIO203"]
+    assert "cycle" in found[0].message
+    # consistent order across both methods: no finding
+    consistent = src.replace(
+        "with self._b_lock:\n                with self._a_lock:",
+        "with self._a_lock:\n                with self._b_lock:",
+    )
+    assert _codes("predictionio_tpu/x.py", consistent) == []
+
+
+def test_pio204_thread_daemon_explicit():
+    bad = """\
+    import threading
+    t = threading.Thread(target=print)
+    """
+    assert _codes("predictionio_tpu/x.py", bad) == ["PIO204"]
+    ok = """\
+    import threading
+    t = threading.Thread(target=print, daemon=False)
+    """
+    assert _codes("predictionio_tpu/x.py", ok) == []
+
+
+# ---------------------------------------------------------------------------
+# PIO3xx JAX hygiene (scoped to ops/ and parallel/)
+# ---------------------------------------------------------------------------
+
+_JIT_ITEM = """\
+import jax
+
+@jax.jit
+def f(x):
+    return x.sum().item()
+"""
+
+
+def test_pio301_host_sync_in_jit():
+    assert _codes("predictionio_tpu/ops/x.py", _JIT_ITEM) == ["PIO301"]
+    # the same source outside the device packages is out of scope
+    assert _codes("predictionio_tpu/api/x.py", _JIT_ITEM) == []
+    # np.asarray through an alias, under functools.partial(jax.jit, ...)
+    np_sync = """\
+    import functools
+    import jax
+    import numpy as np
+
+    @functools.partial(jax.jit, static_argnames=("n",))
+    def f(x, n):
+        return np.asarray(x)
+    """
+    found = _find("predictionio_tpu/ops/x.py", np_sync)
+    assert [f.code for f in found] == ["PIO301"]
+    assert "numpy.asarray" in found[0].message
+    # float() of a traced parameter
+    f_sync = """\
+    import jax
+
+    @jax.jit
+    def f(x):
+        return float(x)
+    """
+    assert _codes("predictionio_tpu/parallel/x.py", f_sync) == ["PIO301"]
+    # float() of a non-parameter local is fine (python scalar math)
+    f_ok = """\
+    import jax
+
+    @jax.jit
+    def f(x):
+        n = 3
+        return x * float(n)
+    """
+    assert _codes("predictionio_tpu/ops/x.py", f_ok) == []
+
+
+def test_pio302_jit_mutable_global():
+    src = """\
+    import jax
+
+    _CACHE = {}
+
+    @jax.jit
+    def f(x):
+        return x * len(_CACHE)
+    """
+    found = _find("predictionio_tpu/ops/x.py", src)
+    assert [f.code for f in found] == ["PIO302"]
+    assert "_CACHE" in found[0].message
+    # an immutable mapping proxy (the als.py fix) does not fire
+    frozen = src.replace(
+        "_CACHE = {}", "_CACHE = types.MappingProxyType({})"
+    ).replace("import jax", "import jax\n    import types")
+    assert _codes("predictionio_tpu/ops/x.py", frozen) == []
+    # file-level suppression flavor (directive can sit anywhere in file)
+    suppressed = textwrap.dedent(src) + "# piolint: disable-file=PIO302\n"
+    assert _codes("predictionio_tpu/ops/x.py", suppressed) == []
+    # the `all` wildcard suppresses every code in the file
+    wildcard = textwrap.dedent(src) + "# piolint: disable-file=all\n"
+    assert _codes("predictionio_tpu/ops/x.py", wildcard) == []
+
+
+def test_pio303_unhashable_static_args():
+    src = """\
+    import jax
+
+    @jax.jit(static_argnums=[0, 1])
+    def f(n, m, x):
+        return x
+    """
+    assert _codes("predictionio_tpu/ops/x.py", src) == ["PIO303"]
+    ok = src.replace("[0, 1]", "(0, 1)")
+    assert _codes("predictionio_tpu/ops/x.py", ok) == []
+
+
+# ---------------------------------------------------------------------------
+# PIO4xx server hygiene
+# ---------------------------------------------------------------------------
+
+
+def test_pio401_untimed_network_call():
+    bad = """\
+    import urllib.request
+    def f(url):
+        return urllib.request.urlopen(url).read()
+    """
+    assert _codes("predictionio_tpu/api/x.py", bad) == ["PIO401"]
+    ok = bad.replace("urlopen(url)", "urlopen(url, timeout=5)")
+    assert _codes("predictionio_tpu/api/x.py", ok) == []
+    # resilience/ owns timeout policy — exempt
+    assert _codes("predictionio_tpu/resilience/x.py", bad) == []
+
+
+def test_pio402_bare_except():
+    src = """\
+    def handler():
+        try:
+            return 200
+        except:
+            return 500
+    """
+    assert _codes("predictionio_tpu/api/x.py", src) == ["PIO402"]
+    ok = src.replace("except:", "except Exception:")
+    assert _codes("predictionio_tpu/api/x.py", ok) == []
+
+
+# ---------------------------------------------------------------------------
+# Baseline mechanics
+# ---------------------------------------------------------------------------
+
+
+def test_baseline_excludes_exact_findings_but_not_new_ones(tmp_path):
+    found = _find("predictionio_tpu/x.py", _LOCKED_CLASS)
+    assert len(found) == 1
+    path = str(tmp_path / "baseline.json")
+    write_baseline(found, path)
+    baseline = load_baseline(path)
+    # identical finding: baselined, not new
+    new, old = split_by_baseline(found, baseline)
+    assert new == [] and len(old) == 1
+    # a SECOND identical finding exceeds the entry's count -> new
+    new, old = split_by_baseline(found + found, baseline)
+    assert len(new) == 1 and len(old) == 1
+    # entries carry a justification slot for review
+    data = json.loads(open(path).read())
+    assert data["entries"][0]["justification"]
+    # a justification survives --update-baseline
+    data["entries"][0]["justification"] = "accepted: fixture"
+    open(path, "w").write(json.dumps(data))
+    write_baseline(found, path)
+    assert (
+        json.loads(open(path).read())["entries"][0]["justification"]
+        == "accepted: fixture"
+    )
+
+
+# ---------------------------------------------------------------------------
+# CLI: pio lint exits nonzero on a seeded violation
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("fmt", ["text", "json"])
+def test_pio_lint_cli_exit_codes(tmp_path, fmt):
+    pkg = tmp_path / "predictionio_tpu" / "serving"
+    pkg.mkdir(parents=True)
+    (pkg / "bad.py").write_text("import jax\n")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+
+    def lint(*extra):
+        return subprocess.run(
+            [
+                sys.executable, "-m", "predictionio_tpu.tools.console",
+                "lint", "--root", str(tmp_path), "--format", fmt, *extra,
+            ],
+            capture_output=True, text=True, timeout=120, env=env, cwd=REPO,
+        )
+
+    proc = lint()
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    if fmt == "json":
+        rec = json.loads(proc.stdout)
+        assert rec["ok"] is False
+        assert rec["countsByCode"].get("PIO101") == 1
+    else:
+        assert "PIO101" in proc.stdout
+    # --update-baseline accepts the finding; the re-run is green
+    proc = lint("--update-baseline")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert (tmp_path / "piolint-baseline.json").exists()
+    proc = lint()
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# ---------------------------------------------------------------------------
+# Tier-1 gate: the real tree lints clean, fast, without importing it
+# ---------------------------------------------------------------------------
+
+
+def test_full_tree_lints_clean_and_fast():
+    """The whole repo passes piolint with no non-baselined findings —
+    this is the tier-1 static-analysis gate. AST-only by design: it must
+    finish well inside 10 s on CPU CI with zero imports of the linted
+    modules (no jax init, no storage, no servers)."""
+    t0 = time.perf_counter()
+    res = run_lint(root=REPO)
+    elapsed = time.perf_counter() - t0
+    assert res.files_scanned > 50
+    assert res.ok, "new piolint findings:\n" + "\n".join(
+        f.render() for f in res.new_findings
+    )
+    assert elapsed < 10.0, f"full-tree lint took {elapsed:.1f}s (AST-only budget is 10s)"
+
+
+def test_deleting_batcher_lock_guard_is_caught():
+    """Acceptance criterion (ISSUE 3): removing any `with self._lock`
+    write guard in serving/batcher.py must fail the lint. Simulated by
+    dedenting each guarded write out of its with-block and linting the
+    mutated source under the real path (so the real baseline applies)."""
+    path = os.path.join(REPO, "predictionio_tpu", "serving", "batcher.py")
+    src = open(path).read()
+    assert "with self._lock:" in src, (
+        "batcher.py no longer has a lock-guarded write — this guard and "
+        "the PIO201 acceptance criterion need updating together"
+    )
+    mutations = 0
+    pos = 0
+    while True:
+        i = src.find("with self._lock:", pos)
+        if i == -1:
+            break
+        # drop the `with` line and dedent its body by one level — the
+        # textual shape of "someone deleted the lock"
+        line_start = src.rfind("\n", 0, i) + 1
+        indent = src[line_start:i]
+        line_end = src.find("\n", i) + 1
+        body_end = line_end
+        while body_end < len(src):
+            nl = src.find("\n", body_end)
+            nl = len(src) if nl == -1 else nl + 1
+            line = src[body_end:nl]
+            if line.strip() and not line.startswith(indent + "    "):
+                break
+            body_end = nl
+        body = src[line_end:body_end].replace("\n" + indent + "    ", "\n" + indent)
+        body = body[4:] if body.startswith(indent + "    ") else body
+        mutated = src[:line_start] + body + src[body_end:]
+        found, _ = lint_file("predictionio_tpu/serving/batcher.py", mutated)
+        assert any(f.code == "PIO201" for f in found), (
+            f"deleting the with-lock at offset {i} went undetected"
+        )
+        # and the real baseline must not mask it
+        baseline = load_baseline(os.path.join(REPO, "piolint-baseline.json"))
+        new, _old = split_by_baseline(found, baseline)
+        assert any(f.code == "PIO201" for f in new)
+        mutations += 1
+        pos = i + 1
+    assert mutations >= 1
+
+
+def test_analysis_package_is_stdlib_only():
+    """The linter must never import what it lints: every import in
+    predictionio_tpu/analysis/ is stdlib or intra-package. Asserted via
+    the engine's own import resolution (dogfooding PIO102), plus a
+    belt-and-braces check that importing the package leaves jax and
+    numpy unimported in a fresh interpreter."""
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "-c",
+            "import sys; import predictionio_tpu.analysis; "
+            "bad = [m for m in ('jax', 'numpy') if m in sys.modules]; "
+            "sys.exit(1 if bad else 0)",
+        ],
+        capture_output=True,
+        text=True,
+        timeout=60,
+        cwd=REPO,
+        env={**os.environ, "PYTHONPATH": REPO},
+    )
+    assert proc.returncode == 0, (
+        "importing predictionio_tpu.analysis pulled in jax/numpy:\n"
+        + proc.stderr
+    )
