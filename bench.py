@@ -911,6 +911,236 @@ def _bench_serving_concurrent(n_clients: int, per_client: int) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# Query-path caching & coalescing under Zipf-skewed load
+# (ISSUE 4 — result LRU + event-driven invalidation + singleflight)
+# ---------------------------------------------------------------------------
+
+
+def _bench_serving_cache(n_clients: int, per_client: int) -> dict:
+    """Zipf-skewed concurrent query workload, cache-off vs the cache
+    stack (result LRU + singleflight coalescing) in the SAME run.
+
+    Real recommendation traffic is dominated by a small hot set; the
+    workload draws users from a Zipf(a) law so repeated identical
+    queries occur the way they do in production. Both runs drive the
+    query path in-process (``service.dispatch``) — the HTTP layer is
+    measured by the ``serving_concurrent`` section; here the transport
+    would only dilute the code path under measurement. During the
+    cached run a background writer bumps the hot users' invalidation
+    scopes (``POST /cache/invalidate.json``), so the reported hit rate
+    includes realistic event-driven churn and the invalidation/stale
+    counters are exercised under load, and a barrier-synchronized
+    burst against a cold key demonstrates singleflight coalescing."""
+    import threading
+
+    from predictionio_tpu.controller import local_context
+    from predictionio_tpu.data.event import DataMap, Event
+    from predictionio_tpu.data.storage import Storage
+    from predictionio_tpu.data.storage.base import App
+    from predictionio_tpu.serving import CacheConfig
+    from predictionio_tpu.workflow import load_engine_variant, run_train
+    from predictionio_tpu.workflow.serving import QueryService
+
+    num_users = int(os.environ.get("BENCH_CACHE_USERS", 5_000))
+    num_items = int(os.environ.get("BENCH_CACHE_ITEMS", 27_000))
+    n_events = int(os.environ.get("BENCH_CACHE_EVENTS", 200_000))
+    zipf_a = float(os.environ.get("BENCH_CACHE_ZIPF_A", 1.2))
+    pin = os.environ.get("BENCH_CACHE_PIN", "")
+    import jax
+
+    pin_model = (
+        pin == "1" if pin else jax.default_backend() not in ("cpu",)
+    )
+    Storage.configure(
+        {
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+            "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+        }
+    )
+    try:
+        app_id = Storage.get_meta_data_apps().insert(App(id=0, name="bench-cache"))
+        rng = np.random.default_rng(11)
+        users = rng.integers(0, num_users, n_events)
+        items = rng.integers(0, num_items, n_events)
+        Storage.get_p_events().write(
+            (
+                Event(
+                    event="rate",
+                    entity_type="user",
+                    entity_id=str(u),
+                    target_entity_type="item",
+                    target_entity_id=str(i),
+                    properties=DataMap({"rating": float((u + i) % 5 + 1)}),
+                )
+                for u, i in zip(users, items)
+            ),
+            app_id,
+        )
+        variant = load_engine_variant(
+            {
+                "id": "bench-cache",
+                "version": "1",
+                "engineFactory": "predictionio_tpu.templates."
+                "recommendation:engine_factory",
+                "datasource": {"params": {"appName": "bench-cache"}},
+                "algorithms": [
+                    {
+                        "name": "als",
+                        "params": {
+                            "rank": 64,
+                            "numIterations": 2,
+                            "lambda": 0.05,
+                            "seed": 11,
+                        },
+                    }
+                ],
+            }
+        )
+        run_train(variant, local_context())
+
+        def run_load(qs: QueryService, invalidate: bool) -> dict:
+            # warm the predict path before timing
+            for _ in range(10):
+                qs.dispatch("POST", "/queries.json", {}, {"user": "0", "num": 10})
+            barrier = threading.Barrier(n_clients + 1)
+            lat: list[list[float]] = [[] for _ in range(n_clients)]
+            errors: list[int] = []
+
+            def client(cid: int) -> None:
+                crng = np.random.default_rng(500 + cid)
+                draws = (crng.zipf(zipf_a, per_client) - 1) % num_users
+                barrier.wait()
+                for u in draws:
+                    t0 = time.perf_counter()
+                    resp = qs.dispatch(
+                        "POST", "/queries.json", {},
+                        {"user": str(int(u)), "num": 10},
+                    )
+                    dt = time.perf_counter() - t0
+                    if resp.status != 200:
+                        errors.append(resp.status)
+                    else:
+                        lat[cid].append(dt)
+
+            stop = threading.Event()
+            bumps = [0]
+
+            def invalidator() -> None:
+                # event-driven churn: writes about the hottest users keep
+                # arriving while they are being served from cache
+                while not stop.wait(0.05):
+                    qs.dispatch(
+                        "POST", "/cache/invalidate.json", {},
+                        {"entityId": str(bumps[0] % 3)},
+                    )
+                    bumps[0] += 1
+
+            threads = [
+                threading.Thread(target=client, args=(c,), daemon=True)
+                for c in range(n_clients)
+            ]
+            inv_thread = None
+            if invalidate:
+                inv_thread = threading.Thread(target=invalidator, daemon=True)
+                inv_thread.start()
+            for t in threads:
+                t.start()
+            barrier.wait()
+            t0 = time.perf_counter()
+            for t in threads:
+                t.join()
+            wall = time.perf_counter() - t0
+            stop.set()
+            if inv_thread is not None:
+                inv_thread.join()
+            lat_ms = np.concatenate(
+                [np.asarray(l) for l in lat if l] or [np.zeros(1)]
+            ) * 1e3
+            completed = int(sum(len(l) for l in lat))
+            return {
+                "queries_per_sec": round(completed / wall, 1),
+                "wall_seconds": round(wall, 3),
+                "requests": completed,
+                "errors": len(errors),
+                "p50_ms": round(float(np.percentile(lat_ms, 50)), 3),
+                "p95_ms": round(float(np.percentile(lat_ms, 95)), 3),
+                "p99_ms": round(float(np.percentile(lat_ms, 99)), 3),
+                "invalidation_bumps": bumps[0],
+            }
+
+        qs_off = QueryService(variant)
+        try:
+            off = run_load(qs_off, invalidate=False)
+        finally:
+            qs_off.close()
+
+        qs_on = QueryService(
+            variant,
+            cache=CacheConfig(
+                result_cache=True,
+                coalesce=True,
+                pin_model=pin_model,
+                result_cache_ttl_s=60.0,
+                scope_field="user",
+            ),
+        )
+        try:
+            on = run_load(qs_on, invalidate=True)
+            # barrier-synchronized burst against cold keys: all clients
+            # miss the same key at once, so exactly one computation runs
+            # and the rest coalesce (retried across fresh keys until the
+            # race is observed — scoring is fast on small smoke shapes)
+            for probe in range(20):
+                if qs_on._cache_stats.to_json()["coalesced"] > 0:
+                    break
+                burst = threading.Barrier(min(16, n_clients))
+
+                def cold(uid: str) -> None:
+                    burst.wait()
+                    qs_on.dispatch(
+                        "POST", "/queries.json", {},
+                        {"user": uid, "num": 10},
+                    )
+
+                uid = str(num_users - 1 - probe)
+                bt = [
+                    threading.Thread(target=cold, args=(uid,), daemon=True)
+                    for _ in range(min(16, n_clients))
+                ]
+                for t in bt:
+                    t.start()
+                for t in bt:
+                    t.join()
+            stats_now = qs_on._cache_stats.to_json()
+        finally:
+            qs_on.close()
+        total = max(1, stats_now["hits"] + stats_now["misses"])
+        return {
+            "concurrency": n_clients,
+            "zipf_a": zipf_a,
+            "users": num_users,
+            "catalog_items": num_items,
+            "pin_model": pin_model,
+            "cache_off": off,
+            "cache_on": on,
+            "cache": {
+                **stats_now,
+                "hitRate": round(stats_now["hits"] / total, 4),
+            },
+            "speedup": round(
+                on["queries_per_sec"] / max(off["queries_per_sec"], 1e-9), 3
+            ),
+            "p99_reduction": round(
+                1.0 - on["p99_ms"] / max(off["p99_ms"], 1e-9), 4
+            ),
+        }
+    finally:
+        Storage.configure(None)
+
+
+# ---------------------------------------------------------------------------
 # Resilience: recovery time + goodput through an injected storage outage
 # (ISSUE 2 — retries, circuit breaker, health probes, graceful degradation)
 # ---------------------------------------------------------------------------
@@ -1454,6 +1684,12 @@ def main() -> None:
         os.environ["BENCH_CONC_EVENTS"] = "4000"
         os.environ["BENCH_CONC_USERS"] = "500"
         os.environ["BENCH_CONC_ITEMS"] = "2000"
+        os.environ["BENCH_CACHE"] = "1"
+        os.environ["BENCH_CACHE_CLIENTS"] = "32"
+        os.environ["BENCH_CACHE_REQUESTS"] = "25"
+        os.environ["BENCH_CACHE_EVENTS"] = "4000"
+        os.environ["BENCH_CACHE_USERS"] = "500"
+        os.environ["BENCH_CACHE_ITEMS"] = "2000"
         os.environ["BENCH_RESILIENCE"] = "1"
         os.environ["BENCH_RES_OUTAGE_S"] = "2.0"
         os.environ["BENCH_RES_CLIENTS"] = "4"
@@ -1549,6 +1785,16 @@ def main() -> None:
             )
         except Exception as e:
             detail["serving_concurrent"] = {"error": str(e)[:300]}
+
+    if os.environ.get("BENCH_CACHE", "1") != "0":
+        cache_clients = int(os.environ.get("BENCH_CACHE_CLIENTS", 32))
+        cache_requests = int(os.environ.get("BENCH_CACHE_REQUESTS", 100))
+        try:
+            detail["serving_cache"] = _bench_serving_cache(
+                cache_clients, cache_requests
+            )
+        except Exception as e:
+            detail["serving_cache"] = {"error": str(e)[:300]}
 
     if os.environ.get("BENCH_BATCHPREDICT", "1") != "0":
         try:

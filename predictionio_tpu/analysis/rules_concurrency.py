@@ -20,6 +20,13 @@ These rules turn each of those into a diagnostic:
 * ``PIO204`` thread without explicit daemon flag: every
   ``threading.Thread(...)`` must pass ``daemon=`` — an implicit
   non-daemon worker silently blocks interpreter shutdown.
+* ``PIO205`` unbounded dict cache in the server hot paths: a module- or
+  instance-level dict under ``serving/`` or ``api/`` that is grown by
+  subscript assignment / ``setdefault`` but never evicted from
+  (``pop``/``popitem``/``clear``/``del``/rebind). Request-keyed maps on
+  a long-lived server are memory leaks an attacker can drive (the
+  event-server access-key cache and the result cache are LRUs for
+  exactly this reason).
 """
 
 from __future__ import annotations
@@ -292,6 +299,165 @@ def check_lock_order(ctx: FileContext) -> Iterator[Finding]:
                 line,
                 "lock-order cycle: " + " -> ".join(cycle) + " (two code "
                 "paths acquire these locks in opposite orders: deadlock)",
+            )
+
+
+#: packages whose long-lived processes make an unbounded request-keyed
+#: dict a leak (the query/event servers); workflow code and one-shot
+#: tools are out of scope
+_CACHE_RULE_PATHS = ("predictionio_tpu/serving/", "predictionio_tpu/api/")
+
+#: zero-arg constructors whose result is a growable mapping
+_DICT_INITS = frozenset(
+    {"dict", "collections.OrderedDict", "collections.defaultdict"}
+)
+
+#: method calls that shrink (or reset) a mapping
+_EVICT_METHODS = frozenset({"pop", "popitem", "clear"})
+
+
+def _is_dict_init(ctx: FileContext, v: ast.AST) -> bool:
+    if isinstance(v, ast.Dict) and not v.keys:
+        return True
+    if isinstance(v, ast.Call):
+        dotted = ctx.dotted_name(v.func)
+        if dotted == "collections.defaultdict":
+            return True
+        return not v.args and not v.keywords and dotted in _DICT_INITS
+    return False
+
+
+def _self_attr(node: ast.AST) -> str | None:
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+@rule(
+    "PIO205",
+    "unbounded-dict-cache",
+    "dict grown in a serving/api hot path with no eviction "
+    "(pop/popitem/clear/del/rebind)",
+)
+def check_unbounded_dict_cache(ctx: FileContext) -> Iterator[Finding]:
+    if not ctx.rel_path.startswith(_CACHE_RULE_PATHS):
+        return
+    # ---------------------------------------------------- instance caches
+    for cls in ast.walk(ctx.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        dict_attrs: set[str] = set()
+        grown: dict[str, int] = {}  # attr -> line of first growth
+        evicted: set[str] = set()
+        for method in cls.body:
+            if not isinstance(method, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            exempt = method.name in _EXEMPT_METHODS
+            for node in ast.walk(method):
+                if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                    targets = (
+                        node.targets
+                        if isinstance(node, ast.Assign)
+                        else [node.target]
+                    )
+                    for t in targets:
+                        attr = _self_attr(t)
+                        if attr is not None and node.value is not None:
+                            if _is_dict_init(ctx, node.value):
+                                dict_attrs.add(attr)
+                            if not exempt:
+                                # any rebind outside __init__ resets the
+                                # map — an eviction mechanism
+                                evicted.add(attr)
+                        # self.x[key] = value — growth
+                        if (
+                            isinstance(t, ast.Subscript)
+                            and _self_attr(t.value) is not None
+                            and not exempt
+                        ):
+                            grown.setdefault(_self_attr(t.value), node.lineno)
+                elif isinstance(node, ast.Call):
+                    f = node.func
+                    if isinstance(f, ast.Attribute):
+                        attr = _self_attr(f.value)
+                        if attr is not None:
+                            if f.attr == "setdefault" and not exempt:
+                                grown.setdefault(attr, node.lineno)
+                            elif f.attr in _EVICT_METHODS:
+                                evicted.add(attr)
+                elif isinstance(node, ast.Delete):
+                    for t in node.targets:
+                        if isinstance(t, ast.Subscript):
+                            attr = _self_attr(t.value)
+                            if attr is not None:
+                                evicted.add(attr)
+        for attr, line in sorted(grown.items(), key=lambda kv: kv[1]):
+            if attr in dict_attrs and attr not in evicted:
+                yield ctx.finding(
+                    "PIO205",
+                    line,
+                    f"self.{attr} grows in {cls.name} with no eviction "
+                    "(unbounded dict cache on a long-lived server; bound "
+                    "it — LRU/TTL — or suppress with a justification)",
+                )
+    # ------------------------------------------------------ module caches
+    module_dicts: set[str] = set()
+    for stmt in ctx.tree.body:
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+            targets = (
+                stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+            )
+            for t in targets:
+                if (
+                    isinstance(t, ast.Name)
+                    and stmt.value is not None
+                    and _is_dict_init(ctx, stmt.value)
+                ):
+                    module_dicts.add(t.id)
+    if not module_dicts:
+        return
+    grown_mod: dict[str, int] = {}
+    evicted_mod: set[str] = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if (
+                    isinstance(t, ast.Subscript)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id in module_dicts
+                ):
+                    grown_mod.setdefault(t.value.id, node.lineno)
+        elif isinstance(node, ast.Call):
+            f = node.func
+            if (
+                isinstance(f, ast.Attribute)
+                and isinstance(f.value, ast.Name)
+                and f.value.id in module_dicts
+            ):
+                if f.attr == "setdefault":
+                    grown_mod.setdefault(f.value.id, node.lineno)
+                elif f.attr in _EVICT_METHODS:
+                    evicted_mod.add(f.value.id)
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                if (
+                    isinstance(t, ast.Subscript)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id in module_dicts
+                ):
+                    evicted_mod.add(t.value.id)
+    for name, line in sorted(grown_mod.items(), key=lambda kv: kv[1]):
+        if name not in evicted_mod:
+            yield ctx.finding(
+                "PIO205",
+                line,
+                f"module dict {name} grows with no eviction (unbounded "
+                "cache in a server module; bound it or suppress with a "
+                "justification)",
             )
 
 

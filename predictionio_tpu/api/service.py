@@ -27,6 +27,7 @@ import os
 import threading
 import time
 import weakref
+from collections import OrderedDict
 from typing import Any, Iterable, Mapping
 
 from predictionio_tpu.api.stats import Stats
@@ -108,14 +109,29 @@ class EventService:
         # store shares the event-table lock, so per-POST lookups convoy).
         # Staleness bound = PIO_ACCESSKEY_CACHE_SECS (0 disables); only
         # positive lookups are cached so a just-created key works at once.
-        self._key_cache: dict[str, tuple[float, Any]] = {}
+        # LRU-bounded (PIO_ACCESSKEY_CACHE_MAX, default 1024): a key-scan
+        # attack or a long-lived multi-tenant server evicts oldest-used
+        # entries one at a time instead of growing without limit (the old
+        # guard cleared the WHOLE cache at the cap, stampeding every hot
+        # key back to the metadata store at once). Hit/miss/eviction
+        # counters surface on /stats.json.
+        self._key_cache: "OrderedDict[str, tuple[float, Any]]" = OrderedDict()
         self._key_cache_lock = threading.Lock()
+        self._key_cache_hits = 0
+        self._key_cache_misses = 0
+        self._key_cache_evictions = 0
         try:
             self._key_cache_ttl = float(
                 os.environ.get("PIO_ACCESSKEY_CACHE_SECS", "2.0")
             )
         except ValueError:
             self._key_cache_ttl = 2.0
+        try:
+            self._key_cache_max = max(
+                1, int(os.environ.get("PIO_ACCESSKEY_CACHE_MAX", "1024"))
+            )
+        except ValueError:
+            self._key_cache_max = 1024
         with _LIVE_SERVICES_LOCK:
             _LIVE_SERVICES.add(self)
 
@@ -136,14 +152,33 @@ class EventService:
         with self._key_cache_lock:
             hit = self._key_cache.get(key)
             if hit is not None and now - hit[0] < self._key_cache_ttl:
+                self._key_cache.move_to_end(key)
+                self._key_cache_hits += 1
                 return hit[1]
+            self._key_cache_misses += 1
         access_key = Storage.get_meta_data_access_keys().get(key)
         if access_key is not None:
             with self._key_cache_lock:
-                if len(self._key_cache) > 1024:  # unbounded-growth guard
-                    self._key_cache.clear()
                 self._key_cache[key] = (now, access_key)
+                self._key_cache.move_to_end(key)
+                while len(self._key_cache) > self._key_cache_max:
+                    self._key_cache.popitem(last=False)
+                    self._key_cache_evictions += 1
         return access_key
+
+    def key_cache_stats(self) -> dict:
+        """Access-key-cache counters for ``GET /stats.json`` — a rising
+        eviction rate with a low hit rate is the signature of a key-scan
+        (each probe misses, fills, and evicts a real tenant's entry)."""
+        with self._key_cache_lock:
+            return {
+                "hits": self._key_cache_hits,
+                "misses": self._key_cache_misses,
+                "evictions": self._key_cache_evictions,
+                "entries": len(self._key_cache),
+                "maxEntries": self._key_cache_max,
+                "ttlSeconds": self._key_cache_ttl,
+            }
 
     # ---------------------------------------------------------------- auth
     def _auth(
@@ -351,7 +386,9 @@ class EventService:
             return auth
         if self.stats is None:
             return _msg(404, "Stats are not enabled (run with --stats).")
-        return Response(200, self.stats.to_json())
+        payload = self.stats.to_json()
+        payload["accessKeyCache"] = self.key_cache_stats()
+        return Response(200, payload)
 
     def webhook(
         self,

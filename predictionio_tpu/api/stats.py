@@ -150,7 +150,8 @@ class ServingStats:
     def record_warmup(self, bucket: int, ms: float) -> None:
         with self._lock:
             self.warmed_buckets.add(bucket)
-            self.warmup_ms[bucket] = round(ms, 3)
+            # bounded by the batcher's finite bucket set, not request data
+            self.warmup_ms[bucket] = round(ms, 3)  # piolint: disable=PIO205
 
     def record_queue_wait(self, ms: float) -> None:
         with self._lock:

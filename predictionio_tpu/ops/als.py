@@ -60,6 +60,15 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+from predictionio_tpu.ops.compat import (
+    reshard,
+    shard_map,
+    sharded_gather,
+    sharded_matmul,
+    sharded_scatter_add,
+    sharded_scatter_set,
+)
+
 __all__ = [
     "ALSConfig",
     "ALSFactors",
@@ -714,7 +723,7 @@ def _gram_chunk(
                 jax.lax.psum(n, model_axis),
             )
 
-        return jax.shard_map(
+        return shard_map(
             local,
             mesh=mesh,
             in_specs=(
@@ -733,8 +742,9 @@ def _gram_chunk(
     if mesh is not None:
         # data-parallel mesh (tables replicated by construction):
         # segment-sharded gather — each device touches only its rows
-        gathered = other.at[chunk_idx].get(
-            out_sharding=NamedSharding(mesh, PartitionSpec(data_axis, None, None))
+        gathered = sharded_gather(
+            other, chunk_idx,
+            NamedSharding(mesh, PartitionSpec(data_axis, None, None)),
         )
     else:
         gathered = other[chunk_idx]
@@ -793,9 +803,9 @@ def _half_sweep(
         # no-op term. From the model-sharded table this is a sharded
         # matmul whose contraction psums over the model axis (ICI).
         if mesh is not None:
-            yty = jnp.matmul(
+            yty = sharded_matmul(
                 other_factors.T, other_factors, precision=hi,
-                out_sharding=NamedSharding(mesh, PartitionSpec(None, None)),
+                sharding=NamedSharding(mesh, PartitionSpec(None, None)),
             )
         else:
             yty = jnp.matmul(other_factors.T, other_factors, precision=hi)
@@ -810,13 +820,10 @@ def _half_sweep(
                 mesh, data_axis, model_axis,
             )
             x = _finish_solve(A, b, n, reg, yty, solver)  # [C, K]
-            if model_sharding is not None:
-                # scatter data-sharded solved rows to their model shard —
-                # GSPMD lowers to the ICI exchange replacing MLlib's
-                # factor-block shuffle
-                fac = fac.at[row_id].set(x, out_sharding=model_sharding)
-            else:
-                fac = fac.at[row_id].set(x)
+            # scatter data-sharded solved rows to their model shard —
+            # GSPMD lowers to the ICI exchange replacing MLlib's
+            # factor-block shuffle
+            fac = sharded_scatter_set(fac, row_id, x, model_sharding)
             return fac, None
 
         factors, _ = jax.lax.scan(step, factors, tuple(ch))
@@ -846,23 +853,15 @@ def _half_sweep(
             # nnz/max_width instead of the hottest row's count. The
             # accumulators are replicated (H_g is config-bounded), so
             # on a mesh the adds psum across the data axis.
-            if replicated is not None:
-                A_acc = A_acc.at[slot].add(A, out_sharding=replicated)
-                b_acc = b_acc.at[slot].add(b, out_sharding=replicated)
-                n_acc = n_acc.at[slot].add(n, out_sharding=replicated)
-            else:
-                A_acc = A_acc.at[slot].add(A)
-                b_acc = b_acc.at[slot].add(b)
-                n_acc = n_acc.at[slot].add(n)
+            A_acc = sharded_scatter_add(A_acc, slot, A, replicated)
+            b_acc = sharded_scatter_add(b_acc, slot, b, replicated)
+            n_acc = sharded_scatter_add(n_acc, slot, n, replicated)
             return (A_acc, b_acc, n_acc), None
 
         acc, _ = jax.lax.scan(hot_step, acc, tuple(ch))
         x_hot = _finish_solve(*acc, reg, yty, solver)  # [num_slots, K]
         hr = jnp.asarray(hot_rows_g)
-        if model_sharding is not None:
-            factors = factors.at[hr].set(x_hot, out_sharding=model_sharding)
-        else:
-            factors = factors.at[hr].set(x_hot)
+        factors = sharded_scatter_set(factors, hr, x_hot, model_sharding)
 
     # padding rows scattered into the sentinel; re-zero it (array index:
     # the scalar-index path rejects/breaks on out_sharding). The sentinel
@@ -870,9 +869,7 @@ def _half_sweep(
     # so its length divides the model axis.
     sentinel = jnp.reshape(jnp.asarray(bucketed.num_rows, jnp.int32), (1,))
     zero = jnp.zeros((1, factors.shape[1]), factors.dtype)
-    if model_sharding is not None:
-        return factors.at[sentinel].set(zero, out_sharding=model_sharding)
-    return factors.at[sentinel].set(zero)
+    return sharded_scatter_set(factors, sentinel, zero, model_sharding)
 
 
 @functools.partial(
@@ -1313,25 +1310,36 @@ def train_als(
         rank = -(-rank // config.rank_pad_multiple) * config.rank_pad_multiple
 
     key_u, key_i = jax.random.split(jax.random.PRNGKey(config.seed))
-    scale = 1.0 / np.sqrt(rank)
     # Table length: num_rows + 1 sentinel row, padded up so the row axis
     # divides the model-axis size (extra rows stay zero, never written).
     model_size = int(mesh.shape.get(model_axis, 1)) if mesh is not None else 1
     n_u = -(-(num_users + 1) // model_size) * model_size
     n_i = -(-(num_items + 1) // model_size) * model_size
-    # MLlib seeds factors with abs(normal)/sqrt(rank) — keeps implicit ALS
-    # preferences non-negative at iteration 0. Unrated rows are zeroed so
-    # cold entities never outscore trained ones (round-1 advisor fix).
+    # MLlib seeds factors with nonnegative abs(normal) rows. On the
+    # implicit objective the rows are additionally normalized to unit L2
+    # (MLlib's exact init): with confidence weighting, an unlucky
+    # small-norm draw parks a row in a slow convergence basin for many
+    # sweeps — measurably, the similar-product fixture needs 5x the
+    # sweeps to separate its item groups from one such draw. The
+    # explicit objective keeps the historical /sqrt(rank) scale (same
+    # expected norm) so explicitly-trained models are bit-identical
+    # across this change. Unrated rows are zeroed so cold entities never
+    # outscore trained ones (round-1 advisor fix).
     u_mask = np.append(u_rated, False)[:, None]
     i_mask = np.append(i_rated, False)[:, None]
     # draw at the canonical (num_rows+1) shape so the init — and therefore
     # the trained factors — are identical across mesh shapes, then zero-pad
     def _seed_table(key, init, num_rows):
         if init is None:
-            return (
-                jnp.abs(jax.random.normal(key, (num_rows + 1, rank), jnp.float32))
-                * scale
+            draw = jnp.abs(
+                jax.random.normal(key, (num_rows + 1, rank), jnp.float32)
             )
+            if config.implicit:
+                norms = jnp.linalg.norm(draw, axis=1, keepdims=True)
+                return draw / jnp.maximum(norms, 1e-9)
+            # multiply by the precomputed reciprocal (not a divide): the
+            # historical op, so explicit inits are bit-identical to it
+            return draw * (1.0 / np.sqrt(rank))
         init = np.asarray(init, dtype=np.float32)
         if init.shape[0] != num_rows:
             raise ValueError(
@@ -1372,8 +1380,8 @@ def train_als(
             # divide the model axis, so a sharded-dim slice is illegal
             # (reshard, not with_sharding_constraint — the latter doesn't
             # change the sharded *type* under explicit-sharding meshes)
-            a = jax.sharding.reshard(a, rep)
-            b = jax.sharding.reshard(b, rep)
+            a = reshard(a, rep)
+            b = reshard(b, rep)
             return a[: num_users + 1], b[: num_items + 1]
 
         # jitted ONCE per train: the jit cache is keyed on the function
@@ -1523,3 +1531,8 @@ def top_k_items_batch(
     scores = user_vecs @ item_factors.T
     values, indices = jax.lax.top_k(scores, k)
     return indices, values
+    # NB: donating the user_idx staging buffer was considered for the
+    # pinned serving path and rejected: XLA input-output aliasing needs
+    # byte-compatible shapes, and the (chunk,) int32 index buffer can
+    # never alias the (chunk, k>=16) outputs — the donation would only
+    # produce "donated buffers were not usable" warnings.

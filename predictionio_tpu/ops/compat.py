@@ -1,0 +1,98 @@
+"""Version-compatibility shims for jax APIs the ops kernels use.
+
+Two APIs the kernels are written against moved homes / landed late:
+
+* ``shard_map`` graduated from ``jax.experimental.shard_map`` to the
+  top-level ``jax.shard_map``; the keyword-only call style (``mesh=``,
+  ``in_specs=``, ``out_specs=``) is identical in both homes, so call
+  sites need no per-version branches.
+* the ``out_sharding=`` hint on ``.at[].set/.add/.get`` and
+  ``jnp.matmul`` (jax >= 0.6, the explicit-sharding work). On older jax
+  the same GSPMD constraint is expressed by wrapping the result in
+  ``jax.lax.with_sharding_constraint`` — inside jit (where every kernel
+  here runs) the compiler sees the identical layout hint, so the chosen
+  ICI exchanges do not change.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "HAS_OUT_SHARDING",
+    "reshard",
+    "shard_map",
+    "sharded_gather",
+    "sharded_matmul",
+    "sharded_scatter_add",
+    "sharded_scatter_set",
+]
+
+try:  # jax >= 0.5 (and late 0.4.x nightlies)
+    from jax import shard_map  # type: ignore[attr-defined]
+except ImportError:  # older jax: the experimental home
+    from jax.experimental.shard_map import shard_map  # noqa: F401
+
+try:  # jax >= 0.6 explicit-sharding API
+    from jax.sharding import reshard  # type: ignore[attr-defined]
+except ImportError:
+    def reshard(x, sharding):
+        """Older jax has no Explicit-mode sharded types, so inside jit a
+        sharding constraint expresses the same layout change the real
+        ``reshard`` performs."""
+        return jax.lax.with_sharding_constraint(x, sharding)
+
+
+def _version_tuple() -> tuple[int, ...]:
+    parts = []
+    for p in jax.__version__.split(".")[:3]:
+        digits = "".join(ch for ch in p if ch.isdigit())
+        parts.append(int(digits or 0))
+    return tuple(parts)
+
+
+#: the ``out_sharding=`` kwarg on indexed update ops / matmul
+HAS_OUT_SHARDING = _version_tuple() >= (0, 6, 0)
+
+
+def _constrained(value: jax.Array, sharding: Any) -> jax.Array:
+    return jax.lax.with_sharding_constraint(value, sharding)
+
+
+def sharded_scatter_set(arr, idx, val, sharding=None) -> jax.Array:
+    """``arr.at[idx].set(val)`` with an output-sharding hint."""
+    if sharding is None:
+        return arr.at[idx].set(val)
+    if HAS_OUT_SHARDING:
+        return arr.at[idx].set(val, out_sharding=sharding)
+    return _constrained(arr.at[idx].set(val), sharding)
+
+
+def sharded_scatter_add(arr, idx, val, sharding=None) -> jax.Array:
+    """``arr.at[idx].add(val)`` with an output-sharding hint."""
+    if sharding is None:
+        return arr.at[idx].add(val)
+    if HAS_OUT_SHARDING:
+        return arr.at[idx].add(val, out_sharding=sharding)
+    return _constrained(arr.at[idx].add(val), sharding)
+
+
+def sharded_gather(arr, idx, sharding=None) -> jax.Array:
+    """``arr[idx]`` with an output-sharding hint."""
+    if sharding is None:
+        return arr[idx]
+    if HAS_OUT_SHARDING:
+        return arr.at[idx].get(out_sharding=sharding)
+    return _constrained(arr[idx], sharding)
+
+
+def sharded_matmul(a, b, precision=None, sharding=None) -> jax.Array:
+    """``jnp.matmul`` with an output-sharding hint."""
+    if sharding is None:
+        return jnp.matmul(a, b, precision=precision)
+    if HAS_OUT_SHARDING:
+        return jnp.matmul(a, b, precision=precision, out_sharding=sharding)
+    return _constrained(jnp.matmul(a, b, precision=precision), sharding)

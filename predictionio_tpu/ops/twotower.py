@@ -39,6 +39,8 @@ import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+from predictionio_tpu.ops.compat import reshard, shard_map
+
 __all__ = [
     "TwoTowerConfig",
     "TwoTowerModel",
@@ -116,7 +118,7 @@ def sharded_embedding_lookup(
         e = tbl[jnp.where(inr, lidx, 0)] * inr[:, None].astype(tbl.dtype)
         return jax.lax.psum(e, model_axis)
 
-    return jax.shard_map(
+    return shard_map(
         local,
         mesh=mesh,
         in_specs=(PartitionSpec(model_axis, None), PartitionSpec(data_axis)),
@@ -196,9 +198,9 @@ def _epoch_program(
             # tiny [B, D] all-gather) — [B@data, B@data] is not a legal
             # layout, and labels must shard like the logits rows
             rep = NamedSharding(mesh, PartitionSpec(None, None))
-            ue_r = jax.sharding.reshard(ue, rep)
-            ie_r = jax.sharding.reshard(ie, rep)
-            labels = jax.sharding.reshard(
+            ue_r = reshard(ue, rep)
+            ie_r = reshard(ie, rep)
+            labels = reshard(
                 labels, NamedSharding(mesh, PartitionSpec(data_axis))
             )
         else:
@@ -226,8 +228,8 @@ def _epoch_program(
         perm = jax.random.permutation(jax.random.fold_in(perm_key, epoch), n_pad)
         r_all, c_all = r[perm], c[perm]
         if rep_sharding is not None:
-            r_all = jax.sharding.reshard(r_all, rep_sharding)
-            c_all = jax.sharding.reshard(c_all, rep_sharding)
+            r_all = reshard(r_all, rep_sharding)
+            c_all = reshard(c_all, rep_sharding)
 
         def body(carry, step):
             p, o = carry
@@ -239,8 +241,8 @@ def _epoch_program(
                 # are Explicit in current jax, and the batch must be
                 # data-sharded before entering the shard_map lookups
                 bspec = NamedSharding(mesh, PartitionSpec(data_axis))
-                u_ids = jax.sharding.reshard(u_ids, bspec)
-                i_ids = jax.sharding.reshard(i_ids, bspec)
+                u_ids = reshard(u_ids, bspec)
+                i_ids = reshard(i_ids, bspec)
             loss, grads = jax.value_and_grad(loss_fn)(p, u_ids, i_ids)
             updates, o = tx.update(grads, o, p)
             return (optax.apply_updates(p, updates), o), loss
@@ -305,9 +307,19 @@ def train_two_tower(
     key = jax.random.PRNGKey(config.seed)
     k_u, k_i, k_perm = jax.random.split(key, 3)
     scale = 1.0 / np.sqrt(D)
+
+    def _draw(k, n_real, n_padded):
+        # draw at the canonical (n_real, D) shape and zero-pad the shard
+        # rows — the jax PRNG keys its stream on the SHAPE, so drawing at
+        # the padded shape would give a mesh whose model axis does not
+        # divide the catalog a different init (hence a different trained
+        # model) than single-device. Same rule as the ALS factor tables.
+        base = jax.random.normal(k, (n_real, D), jnp.float32) * scale
+        return jnp.pad(base, ((0, n_padded - n_real), (0, 0)))
+
     params = {
-        "user": jax.random.normal(k_u, (n_u, D), jnp.float32) * scale,
-        "item": jax.random.normal(k_i, (n_i, D), jnp.float32) * scale,
+        "user": _draw(k_u, num_users, n_u),
+        "item": _draw(k_i, num_items, n_i),
     }
     for name, init, n_real in (
         ("user", init_user, num_users), ("item", init_item, num_items)

@@ -1,14 +1,22 @@
-"""Serving runtime — cross-request dynamic micro-batching.
+"""Serving runtime — cross-request micro-batching, caching, coalescing.
 
-Sits between the HTTP transport and :class:`QueryService`: concurrent
-``POST /queries.json`` requests are coalesced into one
-``handle_batch`` call (one device dispatch per batch instead of one per
-request). See :mod:`predictionio_tpu.serving.batcher`.
+Sits between the HTTP transport and :class:`QueryService`:
 
-This package must stay importable without jax: the batcher is pure
-threading/queue machinery, and tier-1 CI (JAX_PLATFORMS=cpu) guards
-that no accelerator dependency creeps in
-(``tests/test_ci_guards.py::test_serving_runtime_is_accelerator_free``).
+* :mod:`predictionio_tpu.serving.batcher` — concurrent
+  ``POST /queries.json`` requests are coalesced into one
+  ``handle_batch`` call (one device dispatch per batch instead of one
+  per request);
+* :mod:`predictionio_tpu.serving.cache` — result LRU with event-driven
+  invalidation, singleflight dedup of identical in-flight queries, and
+  the config surface for the device-resident model-state tier (which
+  itself lives behind a lazy boundary in
+  :mod:`predictionio_tpu.workflow.device_state`).
+
+This package must stay importable without jax: batching and caching are
+pure threading/queue/dict machinery, and tier-1 CI (JAX_PLATFORMS=cpu)
+guards that no accelerator dependency creeps in (the layering manifest's
+``predictionio_tpu/serving`` entry, asserted by
+``tests/test_ci_guards.py``).
 """
 
 from predictionio_tpu.serving.batcher import (
@@ -16,5 +24,19 @@ from predictionio_tpu.serving.batcher import (
     BatcherConfig,
     MicroBatcher,
 )
+from predictionio_tpu.serving.cache import (
+    CacheConfig,
+    CacheStats,
+    ResultCache,
+    Singleflight,
+)
 
-__all__ = ["AdmissionPolicy", "BatcherConfig", "MicroBatcher"]
+__all__ = [
+    "AdmissionPolicy",
+    "BatcherConfig",
+    "CacheConfig",
+    "CacheStats",
+    "MicroBatcher",
+    "ResultCache",
+    "Singleflight",
+]

@@ -364,6 +364,36 @@ class TwoTowerAlgorithm(JaxAlgorithm):
             self.predict(model, Query(user=model.user_index.keys()[0], num=4))
         return model
 
+    # ------------------------------------------------------ pinned serving
+    def pin_model_for_serving(
+        self, model: TwoTowerServingModel
+    ) -> tuple[TwoTowerServingModel, int]:
+        """``--pin-model`` cache tier (workflow/device_state.py): same
+        contract as the recommendation template — tower matrices are
+        ``device_put`` once per model generation, predictions flip onto
+        the jitted device path, and the pinned bytes surface on
+        ``/stats.json``."""
+        import jax
+
+        user = model.user_vecs
+        item = model.item_vecs
+        if isinstance(user, np.ndarray):
+            user = jax.device_put(user)
+        if isinstance(item, np.ndarray):
+            item = jax.device_put(item)
+        model.user_vecs = user
+        model.item_vecs = item
+        model._pio_pinned = True
+        nbytes = int(user.size) * user.dtype.itemsize
+        nbytes += int(item.size) * item.dtype.itemsize
+        return model, nbytes
+
+    def release_pinned_model(self, model: TwoTowerServingModel) -> None:
+        if getattr(model, "_pio_pinned", False):
+            model.user_vecs = np.asarray(model.user_vecs)
+            model.item_vecs = np.asarray(model.item_vecs)
+            model._pio_pinned = False
+
     def batch_predict(
         self, model: TwoTowerServingModel, queries
     ) -> list[tuple[int, PredictedResult]]:

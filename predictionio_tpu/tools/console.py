@@ -168,6 +168,46 @@ def build_parser() -> argparse.ArgumentParser:
         help="sample query body; every bucket shape is pre-compiled with "
         "it at startup so live traffic never recompiles",
     )
+    # ---- query-path caching & coalescing (predictionio_tpu.serving.cache;
+    # docs/performance.md). Each tier is individually opt-in; with none of
+    # these flags the serving path is byte-identical to a cache-less build.
+    deploy.add_argument(
+        "--result-cache", action="store_true",
+        help="serve repeated identical queries from an in-memory LRU with "
+        "TTL and event-driven invalidation (POST /cache/invalidate.json; "
+        "/reload flushes)",
+    )
+    deploy.add_argument(
+        "--result-cache-entries", type=int, default=4096,
+        help="most entries the result LRU holds (default 4096)",
+    )
+    deploy.add_argument(
+        "--result-cache-ttl-s", type=float, default=30.0,
+        help="seconds a cached result may serve before it expires "
+        "(<= 0: no TTL — entries die only by eviction or invalidation)",
+    )
+    deploy.add_argument(
+        "--result-cache-max-mb", type=float, default=64.0,
+        help="approximate payload-byte budget of the result LRU in MiB "
+        "(<= 0: unbounded)",
+    )
+    deploy.add_argument(
+        "--cache-scope-field", default="user", metavar="FIELD",
+        help="query field naming the per-entity invalidation scope "
+        "(default 'user'); 'none' disables per-scope invalidation",
+    )
+    deploy.add_argument(
+        "--coalesce", action="store_true",
+        help="collapse identical in-flight queries into one scored "
+        "computation whose result fans out to all waiters (singleflight; "
+        "composes with --batching so a batch never holds duplicate work)",
+    )
+    deploy.add_argument(
+        "--pin-model", action="store_true",
+        help="pin factor matrices and the jitted score+top-K programs "
+        "device-resident across requests (no per-request staging or "
+        "re-trace; bytes pinned reported on /stats.json)",
+    )
     # ---- resilience (predictionio_tpu.resilience; docs/operations.md).
     # Defaults are the do-nothing configuration: single-attempt storage
     # calls, no breaker — identical to a build without these flags.
@@ -548,9 +588,28 @@ def main(argv: list[str] | None = None) -> int:
                         else None
                     ),
                 )
+            cache = None
+            if args.result_cache or args.coalesce or args.pin_model:
+                from predictionio_tpu.serving import CacheConfig
+
+                cache = CacheConfig(
+                    result_cache=args.result_cache,
+                    result_cache_entries=args.result_cache_entries,
+                    result_cache_ttl_s=args.result_cache_ttl_s,
+                    result_cache_max_bytes=int(
+                        args.result_cache_max_mb * 1024 * 1024
+                    ),
+                    coalesce=args.coalesce,
+                    pin_model=args.pin_model,
+                    scope_field=(
+                        None
+                        if args.cache_scope_field.lower() in ("none", "")
+                        else args.cache_scope_field
+                    ),
+                )
             service = QueryService(
                 variant, feedback=feedback, instance_id=args.engine_instance_id,
-                batching=batching,
+                batching=batching, cache=cache,
             )
 
             def wire_stop(server):

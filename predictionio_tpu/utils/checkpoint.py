@@ -47,12 +47,12 @@ class CheckpointManager:
         step = step if step is not None else self.latest_step()
         if step is None:
             raise FileNotFoundError("No checkpoint steps found")
-        if like is not None:
-            return self._manager.restore(
-                step,
-                args=self._ocp.args.StandardRestore(like),
-            )
-        return self._manager.restore(step)
+        # explicit StandardRestore even without a template: a FRESH
+        # manager (the resume-on-preemption case) has no handler
+        # registered from a prior save, and argument-less restore then
+        # fails with a CompositeCheckpointHandler KeyError
+        args = self._ocp.args.StandardRestore(like)
+        return self._manager.restore(step, args=args)
 
     def wait(self) -> None:
         self._manager.wait_until_finished()

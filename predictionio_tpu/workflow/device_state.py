@@ -1,0 +1,84 @@
+"""Device-resident serving state — the ``--pin-model`` cache tier.
+
+ALX (arxiv 2112.02194) keeps factor state device-resident across steps
+instead of re-staging it per step; this module applies the same recipe
+to the query path. When a :class:`~predictionio_tpu.serving.cache
+.CacheConfig` enables ``pin_model``, each successful (re)load pins the
+deployed models' scoring state on the accelerator ONCE per model
+generation:
+
+* factor/embedding matrices are ``device_put`` once and reused by every
+  request (no per-request host->device staging);
+* the jitted score+top-K programs those matrices feed are bucket-keyed
+  on static ``k`` (``ops.als.top_k_items_batch``), so after the
+  micro-batcher's warm-up — which flows through this very state — live
+  traffic re-traces nothing;
+* index-buffer donation was evaluated and deliberately omitted: the
+  (chunk,) int32 staging buffer can never alias the larger top-K
+  outputs, so donating it buys nothing and only emits warnings.
+
+Algorithms opt in by implementing ``pin_model_for_serving(model) ->
+(model, bytes_pinned)``; anything else is served untouched. This module
+lives in ``workflow/`` — NOT ``serving/`` — because the serving package
+must stay importable without jax (tier-1 CI guards it); jax itself is
+imported lazily inside the functions so merely importing the workflow
+keeps paying nothing.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Sequence
+
+__all__ = ["pin_pairs", "release_pairs"]
+
+logger = logging.getLogger(__name__)
+
+
+def pin_pairs(pairs: Sequence) -> tuple[list, int]:
+    """Pin every (algorithm, model) pair that supports it.
+
+    Returns ``(pairs, bytes_pinned)`` — the possibly-replaced pair list
+    and the total device bytes now held by pinned state (0 when nothing
+    opted in or jax is unavailable). Pinning is best-effort: a pair
+    whose pin raises is served unpinned rather than failing the load.
+    """
+    try:
+        import jax  # noqa: F401  (availability probe only)
+    except Exception:  # pragma: no cover - jax is a hard dep in practice
+        logger.warning("--pin-model requested but jax is unavailable; "
+                       "serving from host state")
+        return list(pairs), 0
+    out = []
+    total = 0
+    for algo, model in pairs:
+        pin = getattr(algo, "pin_model_for_serving", None)
+        if pin is None:
+            out.append((algo, model))
+            continue
+        try:
+            model, nbytes = pin(model)
+            total += int(nbytes)
+        except Exception:
+            logger.exception(
+                "pin_model_for_serving failed for %s; serving unpinned",
+                type(algo).__name__,
+            )
+        out.append((algo, model))
+    return out, total
+
+
+def release_pairs(pairs: Sequence) -> None:
+    """Drop pinned device state of a superseded model generation so its
+    buffers become collectable immediately (a hot-reloading server must
+    not accumulate one catalog of HBM per reload)."""
+    for algo, model in pairs:
+        release = getattr(algo, "release_pinned_model", None)
+        if release is None:
+            continue
+        try:
+            release(model)
+        except Exception:
+            logger.exception(
+                "release_pinned_model failed for %s", type(algo).__name__
+            )

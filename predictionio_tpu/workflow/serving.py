@@ -27,7 +27,14 @@ from predictionio_tpu.controller.context import WorkflowContext, local_context
 from predictionio_tpu.controller.engine import Engine
 from predictionio_tpu.controller.params import params_from_json, params_to_json
 from predictionio_tpu.data.storage import Storage
-from predictionio_tpu.serving import BatcherConfig, MicroBatcher
+from predictionio_tpu.serving import BatcherConfig, CacheConfig, MicroBatcher
+from predictionio_tpu.serving.cache import (
+    CacheStats,
+    ResultCache,
+    Singleflight,
+    canonical_key,
+    extract_scope,
+)
 from predictionio_tpu.workflow.engine_json import EngineVariant
 
 __all__ = [
@@ -116,6 +123,7 @@ class QueryService:
         feedback: FeedbackConfig | None = None,
         instance_id: str | None = None,
         batching: BatcherConfig | None = None,
+        cache: CacheConfig | None = None,
     ):
         self.variant = variant
         self.ctx = ctx or local_context()
@@ -123,6 +131,26 @@ class QueryService:
         self.feedback = feedback
         self._requested_instance_id = instance_id
         self._lock = threading.Lock()
+        # query-path caching & coalescing (predictionio_tpu.serving.cache;
+        # docs/performance.md). Strictly opt-in: cache=None (or an all-off
+        # config) leaves /queries.json on the exact prior code path. Built
+        # BEFORE reload() so the pin-model tier applies to the first load.
+        self.cache_config = cache if cache is not None and cache.enabled else None
+        self._cache_stats: CacheStats | None = None
+        self._result_cache: ResultCache | None = None
+        self._singleflight: Singleflight | None = None
+        #: monotonically increments on every successful reload; keys the
+        #: singleflight namespace and is reported on /stats.json so an
+        #: operator can correlate cache flushes with model swaps
+        self._model_generation = 0
+        if self.cache_config is not None:
+            self._cache_stats = CacheStats()
+            if self.cache_config.result_cache:
+                self._result_cache = ResultCache(
+                    self.cache_config, self._cache_stats
+                )
+            if self.cache_config.coalesce:
+                self._singleflight = Singleflight(self._cache_stats)
         self._engine: Engine | None = None
         self._serving = None
         self._algo_model_pairs: list = []
@@ -274,6 +302,14 @@ class QueryService:
             serving, pairs = engine.prepare_deploy(
                 self.ctx, engine_params, instance.id, model.models
             )
+            if self.cache_config is not None and self.cache_config.pin_model:
+                # device-resident tier: factor state pinned once per model
+                # generation (lazy boundary — serving/ stays jax-free;
+                # docs/performance.md)
+                from predictionio_tpu.workflow import device_state
+
+                pairs, bytes_pinned = device_state.pin_pairs(pairs)
+                self._cache_stats.set_gauge("bytes_pinned", bytes_pinned)
         except Exception as e:
             with self._lock:
                 has_last_good = self._serving is not None
@@ -284,6 +320,13 @@ class QueryService:
                     last_good = self.instance.id if self.instance else None
             if not has_last_good:
                 raise
+            # conservative cache contract (docs/serving.md): a degraded
+            # server keeps answering from the last-good MODEL but never
+            # from the previous generation's RESULT cache — the failed
+            # reload proves newer training data exists, so cached results
+            # may be stale even though the model is not
+            if self._result_cache is not None:
+                self._result_cache.invalidate_all()
             logger.warning(
                 "Reload failed; still serving last-good instance %s: %s",
                 last_good, e,
@@ -293,6 +336,7 @@ class QueryService:
                 f"'{last_good}'): {e}"
             ) from e
         with self._lock:
+            old_pairs = self._algo_model_pairs
             self._engine = engine
             self._serving = serving
             self._algo_model_pairs = pairs
@@ -300,7 +344,32 @@ class QueryService:
             self.degraded = False
             self.last_reload_error = None
             self.last_reload_at = _dt.datetime.now(_dt.timezone.utc)
-        logger.info("Loaded engine instance %s", instance.id)
+            self._model_generation += 1
+            generation = self._model_generation
+        if self._cache_stats is not None:
+            self._cache_stats.set_gauge("model_generation", generation)
+        if self._result_cache is not None and generation > 1:
+            # a new generation must never serve the old generation's
+            # results; the singleflight namespace is generation-keyed so
+            # in-flight fills die with their generation too
+            self._result_cache.invalidate_all()
+        if (
+            old_pairs
+            and old_pairs is not pairs
+            and self.cache_config is not None
+            and self.cache_config.pin_model
+        ):
+            # free the superseded generation's device buffers promptly.
+            # Functionally safe against in-flight queries that snapshotted
+            # the old pairs: release converts the factor views to host
+            # arrays in place, so a racing query computes on host once
+            # rather than reading freed memory
+            from predictionio_tpu.workflow import device_state
+
+            device_state.release_pairs(old_pairs)
+        logger.info(
+            "Loaded engine instance %s (generation %d)", instance.id, generation
+        )
 
     # --------------------------------------------------------------- query
     @staticmethod
@@ -351,6 +420,83 @@ class QueryService:
         with self._lock:
             self.query_count += 1
         return 200, payload
+
+    # ------------------------------------------------------- cached queries
+    def _scored_query(self, body: Any) -> tuple[int, Any]:
+        """The uncached scoring path — through the micro-batcher when one
+        is configured, else the per-request path."""
+        if self.batcher is not None:
+            return self.batcher.submit(body)
+        return self.handle_query(body)
+
+    def handle_query_cached(self, body: Any) -> tuple[int, Any]:
+        """/queries.json with the cache tiers applied (docs/serving.md):
+
+        1. result-LRU lookup (generation-validated, TTL-bounded);
+        2. on miss, singleflight — identical in-flight queries collapse
+           into one computation, so the micro-batcher downstream never
+           scores duplicate work in one batch;
+        3. the winning computation's 200 result is committed back to the
+           LRU unless an invalidation won the race since the miss
+           (:meth:`ResultCache.commit` drops stale fills).
+
+        Uncacheable bodies (non-JSON-serializable) bypass every tier.
+        Non-200 results are never cached (errors stay per-request), but
+        they do coalesce — N identical failing queries in flight pay one
+        computation."""
+        if self._result_cache is None and self._singleflight is None:
+            return self._scored_query(body)  # pin-model-only config
+        key = canonical_key(body)
+        if key is None:
+            self._cache_stats.incr("uncacheable")
+            return self._scored_query(body)
+        cfg = self.cache_config
+        rc = self._result_cache
+        scope = extract_scope(body, cfg.scope_field)
+        if rc is not None:
+            hit, value = rc.get(key)
+            if hit:
+                return value
+
+        def compute() -> tuple[int, Any]:
+            token = rc.reserve(key, scope) if rc is not None else None
+            result = self._scored_query(body)
+            if rc is not None and result[0] == 200:
+                rc.commit(token, result)
+            return result
+
+        if self._singleflight is not None:
+            # generation-keyed: a flight straddling a /reload never feeds
+            # followers a previous generation's result under the new key
+            flight_key = f"{self._model_generation}:{key}"
+            try:
+                value, _led = self._singleflight.do(flight_key, compute)
+            except TimeoutError as e:
+                return 500, {"message": str(e)}
+            return value
+        return compute()
+
+    def cache_note_write(
+        self, scopes: Sequence[str] | None = None, flush_all: bool = False
+    ) -> dict:
+        """Event-driven invalidation hook (docs/serving.md): a write
+        about ``scopes`` (user/entity ids) makes their cached results
+        stale immediately — entries die on write, not only on TTL. Called
+        by the ``POST /cache/invalidate.json`` route and by in-process
+        ingest pipelines (see ``serving.cache.scopes_from_events`` for
+        mapping event bodies to scopes). ``flush_all`` drops everything
+        (equivalent to what ``/reload`` does on a generation swap)."""
+        if self._result_cache is None:
+            return {"invalidated": 0, "flushed": False}
+        if flush_all:
+            self._result_cache.invalidate_all()
+            return {"invalidated": 0, "flushed": True}
+        count = 0
+        for scope in scopes or ():
+            if isinstance(scope, str) and scope:
+                self._result_cache.invalidate_scope(scope)
+                count += 1
+        return {"invalidated": count, "flushed": False}
 
     def handle_batch(
         self, bodies: Sequence[Any], n_real: int | None = None
@@ -515,6 +661,7 @@ class QueryService:
             "queryCount": self.query_count,
             "feedbackDropped": self.feedback_dropped,
             "batching": self.batcher is not None,
+            "caching": self.cache_config is not None,
             # degraded-mode semantics (docs/operations.md): serving the
             # last-good model after a failed reload
             "degraded": self.degraded,
@@ -554,6 +701,10 @@ class QueryService:
             out["feedback"] = feedback_counts
         if self.batcher is not None:
             out["batcher"] = self.batcher.stats.to_json()
+        if self._cache_stats is not None:
+            # hit/miss/coalesced counters, eviction + invalidation
+            # breakdown, bytes pinned (docs/performance.md)
+            out["cache"] = self._cache_stats.to_json()
         return out
 
     def readiness(self) -> dict:
@@ -599,11 +750,11 @@ class QueryService:
         if path == "/" and method == "GET":
             return Response(200, self.status_json())
         if path == "/queries.json" and method == "POST":
-            if self.batcher is not None:
-                status, payload = self.batcher.submit(body)
+            def to_response(status: int, payload: Any) -> Response:
                 # admission control: tell well-behaved clients when to
                 # come back instead of letting them hot-loop. The value
-                # is computed once, by the batcher, into the payload
+                # is computed once, by the batcher, into the payload —
+                # one shaping rule for the cached and uncached branches
                 if (
                     status in (429, 503)
                     and isinstance(payload, Mapping)
@@ -617,8 +768,40 @@ class QueryService:
                         },
                     )
                 return Response(status, payload)
+
+            if self.cache_config is not None:
+                # result cache + singleflight in front of the (possibly
+                # batched) scoring path; cache off => the exact branches
+                # below, byte-identical to the pre-cache server
+                return to_response(*self.handle_query_cached(body))
+            if self.batcher is not None:
+                return to_response(*self.batcher.submit(body))
             status, payload = self.handle_query(body)
             return Response(status, payload)
+        if path == "/cache/invalidate.json" and method == "POST":
+            # event-driven invalidation hook: {"entityId": "u1"} /
+            # {"entityIds": [...]} / {"all": true} / a list of
+            # event-server-shaped bodies (entityType/entityId)
+            if self._result_cache is None:
+                return Response(
+                    404,
+                    {"message": "No result cache on this deployment "
+                                "(enable with pio deploy --result-cache)."},
+                )
+            scopes: list = []
+            flush_all = False
+            if isinstance(body, Mapping):
+                flush_all = bool(body.get("all"))
+                if isinstance(body.get("entityId"), str):
+                    scopes.append(body["entityId"])
+                ids = body.get("entityIds")
+                if isinstance(ids, list):
+                    scopes.extend(i for i in ids if isinstance(i, str))
+            elif isinstance(body, list):
+                from predictionio_tpu.serving.cache import scopes_from_events
+
+                scopes.extend(sorted(scopes_from_events(body)))
+            return Response(200, self.cache_note_write(scopes, flush_all))
         if path == "/stats.json" and method == "GET":
             return Response(200, self.stats_json())
         if path == "/reload" and method == "POST":

@@ -152,6 +152,55 @@ def test_batching_defaults_leave_single_request_path_alone():
     assert cfg.warmup_body is None  # no surprise traffic at construction
 
 
+def test_caching_defaults_leave_query_path_alone():
+    """ISSUE 4 guard: every cache tier is strictly opt-in. The default
+    QueryService has no cache objects at all (cache=None), an all-off
+    CacheConfig is treated as no config, and with the cache off the
+    /queries.json dispatch takes the exact pre-cache branches — so the
+    cache-off serving path stays byte-identical to the seed path."""
+    import inspect
+
+    from predictionio_tpu.serving import CacheConfig
+    from predictionio_tpu.workflow.serving import QueryService
+
+    sig = inspect.signature(QueryService.__init__)
+    assert sig.parameters["cache"].default is None
+    cfg = CacheConfig()
+    assert cfg.result_cache is False
+    assert cfg.coalesce is False
+    assert cfg.pin_model is False
+    assert cfg.enabled is False
+    # the dispatch source keeps the original per-request/batcher branches
+    # behind the cache_config gate (the cache path must be an addition,
+    # never a rewrite of the default path)
+    import ast as _ast
+    import textwrap
+
+    src = textwrap.dedent(inspect.getsource(QueryService.dispatch))
+    assert "self.batcher.submit(body)" in src
+    assert "self.handle_query(body)" in src
+    _ast.parse(src)
+
+
+def test_serving_cache_module_is_stdlib_only():
+    """The cache tiers that live in serving/ are pure threading/dict
+    machinery; the device-resident tier must stay behind the lazy
+    workflow/ boundary (a jax import here would break the jax-free
+    serving package contract the manifest declares)."""
+    import subprocess
+    import sys
+
+    probe = (
+        "import sys; import predictionio_tpu.serving.cache; "
+        "sys.exit(1 if any(m == 'jax' or m.startswith('jax.') "
+        "for m in sys.modules) else 0)"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", probe], cwd=REPO, capture_output=True
+    )
+    assert proc.returncode == 0, proc.stderr.decode()[-500:]
+
+
 def test_bench_smoke_runs_green():
     """Execute the real bench in --smoke mode (tiny shapes, CPU, <60 s
     budget) and validate its one-line JSON contract."""
@@ -210,6 +259,23 @@ def test_bench_smoke_runs_green():
     batcher = conc["micro_batched"]["batcher"]
     assert batcher["mean_batch_size"] >= 1.0
     assert batcher["bucket_misses_after_warmup"] == 0
+    # query-path cache section (ISSUE 4 acceptance): on the Zipf-skewed
+    # concurrent workload the cache stack must beat the cache-off
+    # baseline by >= 1.5x q/s OR cut p99 by >= 30% in the same run, with
+    # nonzero hit/coalesced/invalidation counts and zero errors on both
+    # sides
+    cache = detail.get("serving_cache")
+    assert cache is not None, "missing bench section 'serving_cache'"
+    assert "error" not in cache, f"serving_cache errored: {cache}"
+    assert cache["concurrency"] >= 32
+    assert cache["cache_off"]["errors"] == 0
+    assert cache["cache_on"]["errors"] == 0
+    assert cache["cache"]["hits"] > 0
+    assert cache["cache"]["coalesced"] > 0
+    assert cache["cache"]["invalidations"]["scope"] > 0
+    assert cache["speedup"] >= 1.5 or cache["p99_reduction"] >= 0.30, (
+        f"cache stack shows no win: {cache}"
+    )
     # resilience section (ISSUE 2 acceptance): through a 2 s injected
     # storage outage under concurrent load there are no raw query 500s,
     # the breaker opens and re-closes, and the probes see the outage and

@@ -203,6 +203,66 @@ class TestEventRoutes:
         # disabled by default
         assert EventService().dispatch("GET", "/stats.json", {"accessKey": key}).status == 404
 
+    def test_key_cache_is_lru_bounded(self, service_env, monkeypatch):
+        """ISSUE 4 satellite: a key-scan (many distinct invalid-then-
+        valid keys) cannot grow the in-process access-key cache without
+        limit — the LRU evicts oldest-used entries one at a time instead
+        of the old clear-everything stampede."""
+        Storage, app_id, key = service_env
+        from predictionio_tpu.data.storage.base import AccessKey
+
+        monkeypatch.setenv("PIO_ACCESSKEY_CACHE_SECS", "3600")
+        monkeypatch.setenv("PIO_ACCESSKEY_CACHE_MAX", "4")
+        svc = EventService()
+        keys = [key]
+        for _ in range(7):
+            keys.append(
+                Storage.get_meta_data_access_keys().insert(
+                    AccessKey(key="", appid=app_id)
+                )
+            )
+        for k in keys:  # 8 distinct keys through a 4-slot cache
+            assert svc.dispatch(
+                "POST", "/events.json", {"accessKey": k}, EV
+            ).status == 201
+        stats = svc.key_cache_stats()
+        assert stats["entries"] <= 4
+        assert stats["maxEntries"] == 4
+        assert stats["evictions"] == 4
+        assert stats["misses"] == 8
+        # an evicted key still authenticates (cache miss, not a 401)
+        assert svc.dispatch(
+            "POST", "/events.json", {"accessKey": keys[0]}, EV
+        ).status == 201
+
+    def test_key_cache_counters_on_stats_route(self, service_env, monkeypatch):
+        _, _, key = service_env
+        monkeypatch.setenv("PIO_ACCESSKEY_CACHE_SECS", "3600")
+        svc = EventService(stats=True)
+        for _ in range(3):
+            svc.dispatch("POST", "/events.json", {"accessKey": key}, EV)
+        r = svc.dispatch("GET", "/stats.json", {"accessKey": key})
+        assert r.status == 200
+        kc = r.body["accessKeyCache"]
+        # 3 posts + the stats GET itself authenticate: 1 miss, 3 hits
+        assert kc["misses"] == 1
+        assert kc["hits"] == 3
+        assert kc["entries"] == 1
+
+    def test_key_cache_invalidation_still_immediate(
+        self, service_env, monkeypatch
+    ):
+        """The LRU rewrite keeps the existing invalidation hooks: an
+        in-process key delete revokes a CACHED key immediately."""
+        from predictionio_tpu.api.service import invalidate_access_key_caches
+
+        _, _, key = service_env
+        monkeypatch.setenv("PIO_ACCESSKEY_CACHE_SECS", "3600")
+        svc = EventService()
+        assert svc.dispatch("POST", "/events.json", {"accessKey": key}, EV).status == 201
+        invalidate_access_key_caches([key])
+        assert svc.key_cache_stats()["entries"] == 0
+
 
 class TestWebhooks:
     def test_examplejson(self, service_env):

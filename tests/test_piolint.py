@@ -248,6 +248,100 @@ def test_pio204_thread_daemon_explicit():
     assert _codes("predictionio_tpu/x.py", ok) == []
 
 
+_UNBOUNDED_INSTANCE = """\
+class Svc:
+    def __init__(self):
+        self._cache = {}
+
+    def handle(self, key, value):
+        self._cache[key] = value
+"""
+
+_BOUNDED_INSTANCE = """\
+class Svc:
+    def __init__(self):
+        self._cache = {}
+
+    def handle(self, key, value):
+        self._cache[key] = value
+        while len(self._cache) > 10:
+            self._cache.popitem()
+"""
+
+
+def test_pio205_unbounded_instance_dict_cache():
+    # fires only in the server packages (serving/, api/)
+    assert _codes("predictionio_tpu/api/x.py", _UNBOUNDED_INSTANCE) == [
+        "PIO205"
+    ]
+    assert _codes("predictionio_tpu/serving/x.py", _UNBOUNDED_INSTANCE) == [
+        "PIO205"
+    ]
+    assert _codes("predictionio_tpu/workflow/x.py", _UNBOUNDED_INSTANCE) == []
+    # any eviction mechanism (pop/popitem/clear/del/rebind) clears it
+    assert _codes("predictionio_tpu/api/x.py", _BOUNDED_INSTANCE) == []
+    deleted = _UNBOUNDED_INSTANCE + """\
+
+    def evict(self, key):
+        del self._cache[key]
+"""
+    assert _codes("predictionio_tpu/api/x.py", deleted) == []
+    rebound = _UNBOUNDED_INSTANCE + """\
+
+    def reset(self):
+        self._cache = {}
+"""
+    assert _codes("predictionio_tpu/api/x.py", rebound) == []
+
+
+def test_pio205_setdefault_counts_as_growth():
+    src = """\
+    class Svc:
+        def __init__(self):
+            self._flights = {}
+
+        def join(self, key):
+            return self._flights.setdefault(key, object())
+    """
+    assert _codes("predictionio_tpu/serving/x.py", src) == ["PIO205"]
+
+
+def test_pio205_module_dict_cache():
+    bad = """\
+    _REGISTRY = {}
+
+    def register(name, value):
+        _REGISTRY[name] = value
+    """
+    assert _codes("predictionio_tpu/api/x.py", bad) == ["PIO205"]
+    ok = bad + """\
+
+    def unregister(name):
+        _REGISTRY.pop(name, None)
+    """
+    assert _codes("predictionio_tpu/api/x.py", ok) == []
+    # non-dict module state and ordinary local dicts never fire
+    local = """\
+    def f():
+        out = {}
+        out["k"] = 1
+        return out
+    """
+    assert _codes("predictionio_tpu/api/x.py", local) == []
+
+
+def test_pio205_suppression():
+    suppressed = """\
+    class Svc:
+        def __init__(self):
+            self._cache = {}
+
+        def handle(self, key, value):
+            self._cache[key] = value  # piolint: disable=PIO205
+    """
+    assert _codes("predictionio_tpu/api/x.py", suppressed) == []
+
+
 # ---------------------------------------------------------------------------
 # PIO3xx JAX hygiene (scoped to ops/ and parallel/)
 # ---------------------------------------------------------------------------

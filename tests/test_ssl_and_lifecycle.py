@@ -15,13 +15,41 @@ from predictionio_tpu.api.http import make_ssl_context, start_background
 
 @pytest.fixture(scope="module")
 def cert_pair(tmp_path_factory):
-    """Self-signed localhost cert via the `cryptography` package."""
-    from cryptography import x509
-    from cryptography.hazmat.primitives import hashes, serialization
-    from cryptography.hazmat.primitives.asymmetric import rsa
-    from cryptography.x509.oid import NameOID
+    """Self-signed localhost cert — via the ``openssl`` binary (present on
+    every CI/dev image this repo targets), falling back to the optional
+    `cryptography` package, else skipping (TLS material is environment
+    tooling, not code under test)."""
+    import shutil
+    import subprocess
 
     d = tmp_path_factory.mktemp("certs")
+    cert_path = d / "server.crt"
+    key_path = d / "server.key"
+    if shutil.which("openssl"):
+        try:
+            subprocess.run(
+                [
+                    "openssl", "req", "-x509", "-newkey", "rsa:2048",
+                    "-keyout", str(key_path), "-out", str(cert_path),
+                    "-days", "1", "-nodes", "-subj", "/CN=localhost",
+                    "-addext", "subjectAltName=DNS:localhost",
+                ],
+                check=True,
+                capture_output=True,
+            )
+            return str(cert_path), str(key_path)
+        except (subprocess.CalledProcessError, OSError):
+            # LibreSSL / OpenSSL < 1.1.1 lack -addext; fall through to
+            # the cryptography-package path rather than ERRORing tests
+            pass
+    try:
+        from cryptography import x509
+        from cryptography.hazmat.primitives import hashes, serialization
+        from cryptography.hazmat.primitives.asymmetric import rsa
+        from cryptography.x509.oid import NameOID
+    except ImportError:
+        pytest.skip("neither openssl nor `cryptography` available")
+
     key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
     name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, "localhost")])
     now = dt.datetime.now(dt.timezone.utc)
@@ -38,8 +66,6 @@ def cert_pair(tmp_path_factory):
         )
         .sign(key, hashes.SHA256())
     )
-    cert_path = d / "server.crt"
-    key_path = d / "server.key"
     cert_path.write_bytes(cert.public_bytes(serialization.Encoding.PEM))
     key_path.write_bytes(
         key.private_bytes(

@@ -83,7 +83,13 @@ class TestShardedLookup:
             return sharded_embedding_lookup(t, ids, ctx.mesh).sum()
 
         g = jax.jit(jax.grad(f))(tbl)
-        assert g.sharding.spec == PartitionSpec("model", None)
+        # is_equivalent_to, not spec ==: jax versions differ on whether
+        # trailing-None axes are kept in the reported spec, and the
+        # property under test is the LAYOUT (model-sharded rows, not
+        # replicated), not the spec's spelling
+        assert g.sharding.is_equivalent_to(
+            NamedSharding(ctx.mesh, PartitionSpec("model", None)), g.ndim
+        )
         np.testing.assert_allclose(
             np.asarray(g), np.vstack([np.ones((8, 4)), np.zeros((8, 4))])
         )
