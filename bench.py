@@ -1634,6 +1634,29 @@ def _bench_event_ingest(Storage, app_id, rng, num_users, num_items) -> dict:
 # ---------------------------------------------------------------------------
 
 
+def _bench_chaos_ingest(cycles: int, writers: int, events: int) -> dict:
+    """Crash-safety drill (ISSUE 5 acceptance): SIGKILL a real event-
+    server subprocess >= `cycles` times under concurrent retrying
+    writers, then verify zero acked loss, zero duplicates, no
+    unquarantined torn files, and a clean SIGTERM drain (exit 0, no raw
+    500s). The smoke guard asserts every invariant — a bench run whose
+    ingestion can lose or double-count an acked event cannot go green."""
+    from predictionio_tpu.resilience.chaos import ChaosConfig, run_chaos_ingest
+
+    t0 = time.perf_counter()
+    report = run_chaos_ingest(
+        ChaosConfig(
+            cycles=cycles,
+            writers=writers,
+            events_per_writer=events,
+            backend=os.environ.get("BENCH_CHAOS_BACKEND", "sqlite"),
+            seed=int(os.environ.get("BENCH_CHAOS_SEED", "0")),
+        )
+    )
+    report["seconds"] = round(time.perf_counter() - t0, 3)
+    return report
+
+
 def _bench_lint() -> dict:
     """Full-tree piolint pass (predictionio_tpu.analysis — AST only, no
     imports of linted modules, no jax init). Reporting the rule and
@@ -1694,6 +1717,11 @@ def main() -> None:
         os.environ["BENCH_RES_OUTAGE_S"] = "2.0"
         os.environ["BENCH_RES_CLIENTS"] = "4"
         os.environ["BENCH_RES_EVENTS"] = "3000"
+        os.environ["BENCH_CHAOS"] = "1"
+        os.environ["BENCH_CHAOS_CYCLES"] = "3"
+        os.environ["BENCH_CHAOS_WRITERS"] = "3"
+        os.environ["BENCH_CHAOS_EVENTS"] = "40"
+        os.environ["BENCH_CHAOS_BACKEND"] = "sqlite"
         os.environ["BENCH_LINT"] = "1"
         os.environ.pop("BENCH_PRECISION_COMPARE", None)
         # fresh compile cache: a persistent cache populated on a different
@@ -1809,6 +1837,16 @@ def main() -> None:
             detail["resilience"] = _bench_resilience(outage_s, res_clients)
         except Exception as e:
             detail["resilience"] = {"error": str(e)[:300]}
+
+    if os.environ.get("BENCH_CHAOS", "1") != "0":
+        try:
+            detail["chaos_ingest"] = _bench_chaos_ingest(
+                cycles=int(os.environ.get("BENCH_CHAOS_CYCLES", 3)),
+                writers=int(os.environ.get("BENCH_CHAOS_WRITERS", 4)),
+                events=int(os.environ.get("BENCH_CHAOS_EVENTS", 120)),
+            )
+        except Exception as e:
+            detail["chaos_ingest"] = {"error": str(e)[:300]}
 
     if os.environ.get("BENCH_LINT", "1") != "0":
         try:

@@ -83,6 +83,13 @@ DEFAULT_MANIFEST: Manifest = (
         "jax initialization",
     ),
     PackageRule(
+        package="predictionio_tpu/api/lifecycle.py",
+        stdlib_only=True,
+        reason="graceful drain/shutdown must work on every server with no "
+        "storage, numpy, or accelerator imports — flush hooks are "
+        "injected by the caller, never imported",
+    ),
+    PackageRule(
         package="predictionio_tpu/data",
         forbid=(
             "predictionio_tpu.workflow",
@@ -106,9 +113,13 @@ DEFAULT_MANIFEST: Manifest = (
 
 def rules_for(rel_path: str, manifest: Manifest) -> list[PackageRule]:
     """Manifest entries whose package prefix contains ``rel_path``,
-    most specific first."""
+    most specific first. A ``package`` may also name a single FILE
+    (``predictionio_tpu/api/lifecycle.py``) to pin one module's contract
+    without constraining its siblings."""
     rel = rel_path.replace("\\", "/")
-    hits = [r for r in manifest if rel.startswith(r.package + "/")]
+    hits = [
+        r for r in manifest if rel == r.package or rel.startswith(r.package + "/")
+    ]
     hits.sort(key=lambda r: len(r.package), reverse=True)
     return hits
 

@@ -16,7 +16,7 @@ import ssl
 import threading
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Callable, Mapping
+from typing import TYPE_CHECKING, Callable, Mapping
 
 __all__ = [
     "serve",
@@ -66,6 +66,9 @@ Dispatcher = Callable[..., "object"]
 #: GET /readyz (see _make_handler)
 ReadinessHook = Callable[[], Mapping]
 
+if TYPE_CHECKING:
+    from predictionio_tpu.api.lifecycle import DrainManager
+
 
 def _resolve_readiness(
     dispatch: Dispatcher, readiness: ReadinessHook | None
@@ -81,7 +84,11 @@ def _resolve_readiness(
     return hook if callable(hook) else None
 
 
-def _make_handler(dispatch: Dispatcher, readiness: ReadinessHook | None = None):
+def _make_handler(
+    dispatch: Dispatcher,
+    readiness: ReadinessHook | None = None,
+    lifecycle: "DrainManager | None" = None,
+):
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
         #: per-connection socket timeout — bounds stalled clients (incl.
@@ -111,6 +118,34 @@ def _make_handler(dispatch: Dispatcher, readiness: ReadinessHook | None = None):
             if self.command == "GET" and parsed.path == "/readyz":
                 self._ready_probe()
                 return
+            if lifecycle is not None:
+                # graceful drain (docs/operations.md): once draining, new
+                # work is refused with a clean 503 + Retry-After while
+                # requests already admitted run to completion. Admission
+                # and the in-flight count are one atomic step, so the
+                # drain's idle-wait can never miss a racing request.
+                if not lifecycle.try_begin_request():
+                    # Connection: close (send_header flips close_connection
+                    # too): the rejection never reads the request body, so
+                    # a kept-alive connection would desync on the unread
+                    # bytes — and a draining listener is going away anyway
+                    self._send(
+                        503,
+                        b'{"message": "Server is draining; retry elsewhere."}',
+                        extra_headers={
+                            "Retry-After": str(lifecycle.retry_after_s()),
+                            "Connection": "close",
+                        },
+                    )
+                    return
+                try:
+                    self._dispatch_and_send(parsed)
+                finally:
+                    lifecycle.end_request()
+                return
+            self._dispatch_and_send(parsed)
+
+        def _dispatch_and_send(self, parsed):
             params = {
                 k: v[0] for k, v in urllib.parse.parse_qs(parsed.query).items()
             }
@@ -158,7 +193,12 @@ def _make_handler(dispatch: Dispatcher, readiness: ReadinessHook | None = None):
         def _ready_probe(self):
             """GET /readyz: 200 when the service's readiness hook says
             every dependency check passed, 503 otherwise. Servers without
-            a hook are ready whenever they are alive."""
+            a hook are ready whenever they are alive. A draining server
+            is never ready — the balancer must stop routing here before
+            the listener goes away."""
+            if lifecycle is not None and lifecycle.draining:
+                self._send(503, b'{"ready": false, "draining": true}')
+                return
             if readiness is None:
                 self._send(200, b'{"ready": true, "checks": {}}')
                 return
@@ -199,15 +239,35 @@ class _Server(ThreadingHTTPServer):
     request_queue_size = 128
 
 
+def _resolve_drain_hook(dispatch: Dispatcher) -> Callable[[], None] | None:
+    """A service object's ``drain`` method, discovered from a bound
+    ``dispatch`` the same way readiness is — so the query server's
+    micro-batcher close (``QueryService.drain``) runs in the drain
+    sequence without per-server wiring."""
+    owner = getattr(dispatch, "__self__", None)
+    hook = getattr(owner, "drain", None)
+    return hook if callable(hook) else None
+
+
 def _make_server(
     dispatch: Dispatcher,
     host: str,
     port: int,
     ssl_context: ssl.SSLContext | None,
     readiness: ReadinessHook | None = None,
+    lifecycle: "DrainManager | None" = None,
 ) -> ThreadingHTTPServer:
-    handler = _make_handler(dispatch, _resolve_readiness(dispatch, readiness))
+    handler = _make_handler(
+        dispatch, _resolve_readiness(dispatch, readiness), lifecycle
+    )
     server = _Server((host, port), handler)
+    if lifecycle is not None:
+        lifecycle.attach_server(server)
+        drain_hook = _resolve_drain_hook(dispatch)
+        if drain_hook is not None:
+            # ahead of any process-level hooks (storage flush): the
+            # service must release its own machinery first
+            lifecycle.add_drain_hook(drain_hook, first=True)
     if ssl_context is not None:
         # defer the handshake to the per-connection worker thread: with
         # do_handshake_on_connect=True it would run inside accept() on
@@ -227,14 +287,17 @@ def serve(
     ssl_context: ssl.SSLContext | None = None,
     ready_callback: Callable[[ThreadingHTTPServer], None] | None = None,
     readiness: ReadinessHook | None = None,
+    lifecycle: "DrainManager | None" = None,
 ) -> None:
     """Blocking serve-forever (used by ``pio eventserver`` / ``pio deploy``).
 
     ``ready_callback`` receives the bound server before requests flow —
     deploy uses it to wire the ``GET /stop`` shutdown hook. ``readiness``
     backs ``GET /readyz`` (defaults to the service's own ``readiness``
-    method when ``dispatch`` is a bound method)."""
-    server = _make_server(dispatch, host, port, ssl_context, readiness)
+    method when ``dispatch`` is a bound method). ``lifecycle`` (opt-in,
+    ``--drain-deadline-s``) enables graceful signal-driven drain; without
+    it signal behavior is the historical immediate exit."""
+    server = _make_server(dispatch, host, port, ssl_context, readiness, lifecycle)
     logger.info(
         "Listening on %s://%s:%d",
         "https" if ssl_context else "http", host, port,
@@ -253,11 +316,12 @@ def start_background(
     port: int = 0,
     ssl_context: ssl.SSLContext | None = None,
     readiness: ReadinessHook | None = None,
+    lifecycle: "DrainManager | None" = None,
 ) -> tuple[ThreadingHTTPServer, threading.Thread]:
     """Start on a daemon thread; returns (server, thread). ``port=0`` picks
     a free port (``server.server_address[1]``). Used by tests and the
     feedback loop."""
-    server = _make_server(dispatch, host, port, ssl_context, readiness)
+    server = _make_server(dispatch, host, port, ssl_context, readiness, lifecycle)
     thread = threading.Thread(target=server.serve_forever, daemon=True)
     thread.start()
     return server, thread

@@ -132,6 +132,14 @@ class EventService:
             )
         except ValueError:
             self._key_cache_max = 1024
+        # idempotent-ingestion counters (docs/eventserver.md): a hit is a
+        # duplicate client-supplied eventId answered without a second
+        # write; a miss is a client-supplied id seen for the first time.
+        # Retrying clients produce a low steady hit rate; a SPIKE usually
+        # means a crashed-and-restarted client is replaying its backlog.
+        self._dedup_lock = threading.Lock()
+        self._dedup_hits = 0
+        self._dedup_misses = 0
         with _LIVE_SERVICES_LOCK:
             _LIVE_SERVICES.add(self)
 
@@ -253,12 +261,36 @@ class EventService:
             return _msg(403, f"Event '{event.event}' is not allowed by this accessKey.")
         return event
 
+    def _record_dedup(self, supplied: bool, duplicate: bool) -> None:
+        if not supplied:
+            return
+        with self._dedup_lock:
+            if duplicate:
+                self._dedup_hits += 1
+            else:
+                self._dedup_misses += 1
+
+    def dedup_stats(self) -> dict:
+        with self._dedup_lock:
+            return {"hits": self._dedup_hits, "misses": self._dedup_misses}
+
     def _insert_one(self, body: Any, access_key, channel_id) -> Response:
         event = self._validate_item(body, access_key)
         if isinstance(event, Response):
             return event
-        event_id = Storage.get_l_events().insert(event, access_key.appid, channel_id)
-        return Response(201, {"eventId": event_id})
+        # client-supplied eventId = idempotency key: a retried POST gets
+        # the ORIGINAL id back with `"duplicate": true` instead of a
+        # second stored event. Without an eventId the write path is the
+        # historical generate-and-insert, unchanged (dedup is strictly
+        # per-event opt-in; CI-guarded).
+        event_id, duplicate = Storage.get_l_events().insert_dedup(
+            event, access_key.appid, channel_id
+        )
+        self._record_dedup(bool(event.event_id), duplicate)
+        payload: dict = {"eventId": event_id}
+        if duplicate:
+            payload["duplicate"] = True
+        return Response(201, payload)
 
     def create_events_batch(
         self,
@@ -292,7 +324,7 @@ class EventService:
             results.append(None)  # filled after the bulk insert
         if valid:
             try:
-                ids = Storage.get_l_events().insert_batch(
+                results_dedup = Storage.get_l_events().insert_batch_dedup(
                     [e for _, e in valid], access_key.appid, channel_id
                 )
             except Exception:
@@ -309,8 +341,12 @@ class EventService:
                         "message": "Storage error: event was not stored.",
                     }
             else:
-                for (slot, _), eid in zip(valid, ids):
-                    results[slot] = {"eventId": eid, "status": 201}
+                for (slot, event), (eid, dup) in zip(valid, results_dedup):
+                    entry = {"eventId": eid, "status": 201}
+                    if dup:
+                        entry["duplicate"] = True
+                    self._record_dedup(bool(event.event_id), dup)
+                    results[slot] = entry
         for item, entry in zip(body, results):
             self._record_stats(access_key.appid, item, entry["status"])
         return Response(200, results)
@@ -388,6 +424,7 @@ class EventService:
             return _msg(404, "Stats are not enabled (run with --stats).")
         payload = self.stats.to_json()
         payload["accessKeyCache"] = self.key_cache_stats()
+        payload["dedup"] = self.dedup_stats()
         return Response(200, payload)
 
     def webhook(

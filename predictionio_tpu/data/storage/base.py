@@ -323,6 +323,36 @@ class LEvents(abc.ABC):
     ) -> list[str]:
         return [self.insert(e, app_id, channel_id) for e in events]
 
+    def insert_dedup(
+        self, event: Event, app_id: int, channel_id: int | None = None
+    ) -> tuple[str, bool]:
+        """Idempotent insert keyed on a CLIENT-SUPPLIED ``event_id``:
+        returns ``(event_id, duplicate)``. When the id was already
+        stored, the original event is kept untouched and ``duplicate`` is
+        True — which is what makes a retried ``POST /events.json`` (and a
+        retried storage-RPC write) safe: re-sending the same event can
+        never double-count it. Events WITHOUT a client id take the plain
+        :meth:`insert` path unchanged (dedup is strictly opt-in per
+        event). The base implementation has no dedup index; durable
+        drivers override it through their existing commit paths."""
+        return self.insert(event, app_id, channel_id), False
+
+    def insert_batch_dedup(
+        self, events: Sequence[Event], app_id: int, channel_id: int | None = None
+    ) -> list[tuple[str, bool]]:
+        """Batch flavor of :meth:`insert_dedup`; duplicates are detected
+        against the store AND earlier items of the same batch. Drivers
+        override to keep the batch route's single-transaction
+        amortization; for drivers that did not, a batch with no client
+        ids (nothing to dedup) still takes their optimized
+        :meth:`insert_batch` in one shot."""
+        if not any(e.event_id for e in events):
+            return [
+                (eid, False)
+                for eid in self.insert_batch(events, app_id, channel_id)
+            ]
+        return [self.insert_dedup(e, app_id, channel_id) for e in events]
+
     @abc.abstractmethod
     def get(self, event_id: str, app_id: int, channel_id: int | None = None) -> Event | None: ...
 
@@ -462,6 +492,15 @@ class BaseStorageClient(abc.ABC):
 
     def get_p_events(self) -> PEvents:
         raise self._unsupported("event data (PEvents)")
+
+    def recovery_report(self) -> dict:
+        """Summary of the driver's startup recovery sweep: what it found
+        on open (orphan temp files, torn commit points, torn tail lines)
+        and where it quarantined them. Suspect files are **moved aside,
+        never deleted** — an operator can inspect and, if a bug rather
+        than a crash produced them, recover data. Default: nothing to
+        sweep (backends with native crash recovery, e.g. sqlite WAL)."""
+        return {"quarantined": [], "notes": []}
 
     def close(self) -> None:
         pass

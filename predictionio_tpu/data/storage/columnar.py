@@ -229,8 +229,15 @@ class _ColumnarEvents(LEvents):
     #: ~cache_size·segment_rows rows instead of pinning the whole store
     _CACHE_SEGMENTS = 8
 
+    #: recent client-supplied event ids remembered per stream for O(1)
+    #: duplicate detection (ids beyond the window fall back to the exact
+    #: per-segment/tail lookup). Durability is free: the tail itself is
+    #: the record — after a restart the window re-warms from it.
+    _DEDUP_WINDOW = 100_000
+
     def __init__(self, base: str, segment_rows: int, fsync: bool,
-                 cache_segments: int | None = None):
+                 cache_segments: int | None = None,
+                 dedup_window: int | None = None):
         self._base = base
         self._segment_rows = segment_rows
         self._fsync = fsync
@@ -238,6 +245,16 @@ class _ColumnarEvents(LEvents):
         from collections import OrderedDict
 
         self._seg_cache: "OrderedDict[str, _Segment]" = OrderedDict()
+        #: stream dir -> LRU of recently seen event ids (insert_dedup)
+        self._recent_ids: dict[str, "OrderedDict[str, None]"] = {}
+        #: stream dir -> does the LRU provably hold EVERY live tail id?
+        #: (warmed from a tail that fit the window and never evicted
+        #: since). While True, a dedup miss can skip the O(tail) scan
+        #: and check only the indexed segments.
+        self._recent_complete: dict[str, bool] = {}
+        self._dedup_window = (
+            self._DEDUP_WINDOW if dedup_window is None else max(1, dedup_window)
+        )
         #: per-path point-lookup indexes: None = positional segment
         #: (cached indefinitely — a few bytes), (sorted ids, argsort
         #: rows) = explicit-id segment (LRU-bounded; a huge segment's
@@ -361,6 +378,107 @@ class _ColumnarEvents(LEvents):
         except FileNotFoundError:
             pass
 
+    # -------------------------------------------------- startup recovery
+    def _quarantine_file(self, d: str, path: str, report: dict) -> None:
+        """Move a suspect file into the stream's ``quarantine/`` dir —
+        never delete: a crash normally explains an orphan, but if a bug
+        produced it the bytes are still recoverable by an operator."""
+        qdir = os.path.join(d, "quarantine")
+        os.makedirs(qdir, exist_ok=True)
+        dest = os.path.join(
+            qdir, f"{os.path.basename(path)}.{uuid.uuid4().hex[:8]}"
+        )
+        os.replace(path, dest)
+        report["quarantined"].append(dest)
+
+    def _repair_tail(self, d: str, report: dict) -> None:
+        """Trim torn tail lines (a crash mid-append leaves a partial last
+        line that would poison every subsequent scan). Torn bytes are
+        quarantined, valid lines kept; a torn line was by definition
+        never acknowledged to a client, so trimming it loses nothing
+        that was promised durable."""
+        tail = os.path.join(d, "tail.jsonl")
+        try:
+            with open(tail, "rb") as f:
+                raw = f.read()
+        except FileNotFoundError:
+            return
+        good: list[bytes] = []
+        bad: list[bytes] = []
+        for line in raw.split(b"\n"):
+            if not line.strip():
+                continue
+            try:
+                json.loads(line)
+            except (json.JSONDecodeError, UnicodeDecodeError):
+                bad.append(line)
+            else:
+                good.append(line)
+        if not bad:
+            return
+        qdir = os.path.join(d, "quarantine")
+        os.makedirs(qdir, exist_ok=True)
+        dest = os.path.join(qdir, f"tail.torn.{uuid.uuid4().hex[:8]}.jsonl")
+        with open(dest, "wb") as f:
+            f.write(b"\n".join(bad) + b"\n")
+        tmp = tail + ".repair"
+        with open(tmp, "wb") as f:
+            f.write(b"".join(ln + b"\n" for ln in good))
+            if self._fsync:
+                f.flush()
+                os.fsync(f.fileno())
+        os.replace(tmp, tail)
+        report["quarantined"].append(dest)
+        report["tornTailLines"] += len(bad)
+
+    def sweep_recovery(self) -> dict:
+        """Scan every stream directory on open: replay committed
+        compactions, quarantine orphan temp/staging files and torn
+        commit markers, and trim torn tail lines. Returns the summary
+        the driver reports via ``recovery_report()``."""
+        report: dict = {
+            "streams": 0,
+            "quarantined": [],
+            "replayedCommits": 0,
+            "tornTailLines": 0,
+        }
+        if not os.path.isdir(self._base):
+            return report
+        stream_dirs = []
+        for app in sorted(os.listdir(self._base)):
+            app_dir = os.path.join(self._base, app)
+            if not (app.startswith("app_") and os.path.isdir(app_dir)):
+                continue
+            for ch in sorted(os.listdir(app_dir)):
+                d = os.path.join(app_dir, ch)
+                if os.path.isdir(d):
+                    stream_dirs.append(d)
+        with self._lock:
+            for d in stream_dirs:
+                report["streams"] += 1
+                marker = os.path.join(d, "compact.commit")
+                if os.path.exists(marker):
+                    try:
+                        with open(marker) as f:
+                            json.load(f)["pending"]
+                    except Exception:
+                        # torn marker: the compaction never committed —
+                        # quarantine it so _recover can't trip on it; the
+                        # staged .pending files become orphans below and
+                        # the (still intact) tail remains authoritative
+                        self._quarantine_file(d, marker, report)
+                    else:
+                        self._recover(d)
+                        report["replayedCommits"] += 1
+                for name in sorted(os.listdir(d)):
+                    if name.endswith((".tmp", ".pending", ".pending.tmp",
+                                      ".repair")):
+                        self._quarantine_file(
+                            d, os.path.join(d, name), report
+                        )
+                self._repair_tail(d, report)
+        return report
+
     def _tombstones(self, d: str) -> set[str]:
         try:
             with open(os.path.join(d, "tombstones.txt")) as f:
@@ -463,6 +581,8 @@ class _ColumnarEvents(LEvents):
                 del self._seg_cache[p]
             for p in [p for p in self._ids_cache if p.startswith(d)]:
                 del self._ids_cache[p]
+            self._recent_ids.pop(d, None)
+            self._recent_complete.pop(d, None)
         return True
 
     def insert(self, event: Event, app_id: int, channel_id: int | None = None) -> str:
@@ -487,7 +607,98 @@ class _ColumnarEvents(LEvents):
                 if self._fsync:
                     f.flush()
                     os.fsync(f.fileno())
+            lru = self._recent_ids.get(d)
+            if lru is not None:
+                # keep a built dedup window coherent with non-dedup
+                # appends (webhook/import paths) so its tail-coverage
+                # claim stays true
+                for eid in ids:
+                    self._remember_id(d, lru, eid)
         return ids
+
+    # ----------------------------------------------------- idempotent insert
+    def _recent_ids_for(self, d: str) -> "Any":
+        """The stream's recent-id LRU, warmed from the live tail on first
+        use (so dedup keeps working across a process restart without a
+        per-insert tail scan). Caller holds the store lock."""
+        lru = self._recent_ids.get(d)
+        if lru is None:
+            from collections import OrderedDict
+
+            lru = OrderedDict()
+            try:
+                with open(os.path.join(d, "tail.jsonl")) as f:
+                    raw = [ln for ln in f if ln.strip()]
+            except FileNotFoundError:
+                raw = []
+            for line in raw[-self._dedup_window:]:
+                try:
+                    eid = json.loads(line).get("eventId")
+                except json.JSONDecodeError:
+                    continue  # torn line; the recovery sweep owns repair
+                if eid:
+                    lru[str(eid)] = None
+            self._recent_ids[d] = lru
+            # every live tail line made it into the window (torn lines
+            # were never acked) -> an LRU miss rules the tail out
+            self._recent_complete[d] = len(raw) <= self._dedup_window
+        return lru
+
+    def _remember_id(self, d: str, lru: "Any", eid: str) -> None:
+        lru[eid] = None
+        lru.move_to_end(eid)
+        while len(lru) > self._dedup_window:
+            lru.popitem(last=False)
+            self._recent_complete[d] = False  # evicted: window < tail
+
+    def insert_dedup(
+        self, event: Event, app_id: int, channel_id: int | None = None
+    ) -> tuple[str, bool]:
+        return self.insert_batch_dedup([event], app_id, channel_id)[0]
+
+    def insert_batch_dedup(
+        self, events: Sequence[Event], app_id: int, channel_id: int | None = None
+    ) -> list[tuple[str, bool]]:
+        """Idempotent append: client-supplied ids are checked against the
+        recent-id window (O(1)), falling back to the exact tail/segment
+        lookup for ids older than the window; fresh events land through
+        the normal single-fsync batch append. Check and append happen
+        under one store lock, so concurrent retries of the same event
+        cannot both pass the membership test."""
+        d = self._ensure_stream(app_id, channel_id)
+        out: list[tuple[str, bool] | None] = []
+        fresh: list[Event] = []
+        with self._lock:
+            self._recover(d)
+            lru = self._recent_ids_for(d)
+            for e in events:
+                eid = e.event_id
+                if not eid:
+                    e = e.with_event_id(new_event_id())
+                    fresh.append(e)
+                    out.append((e.event_id, False))  # type: ignore[arg-type]
+                    continue
+                if eid in lru:
+                    lru.move_to_end(eid)
+                    out.append((eid, True))
+                    continue
+                # LRU miss. When the window provably covers the whole
+                # tail, only the (indexed, O(log rows)) segments remain
+                # to check; otherwise fall back to the exact full lookup
+                # — never an O(tail) decode per insert on the hot path.
+                if self._recent_complete.get(d, False):
+                    dup = self._lookup_segments(eid, d) is not None
+                else:
+                    dup = self._lookup(eid, d)[0] is not None
+                self._remember_id(d, lru, eid)  # also dedups within the batch
+                if dup:
+                    out.append((eid, True))
+                    continue
+                fresh.append(e)
+                out.append((eid, False))
+            if fresh:
+                self.insert_batch(fresh, app_id, channel_id)
+        return out  # type: ignore[return-value]
 
     def compact(self, app_id: int, channel_id: int | None = None) -> int:
         """Seal the live JSONL tail into explicit-id segments and drop
@@ -555,6 +766,12 @@ class _ColumnarEvents(LEvents):
         for e in self._tail_events(d):
             if e.event_id == event_id:
                 return e, True
+        return self._lookup_segments(event_id, d), False
+
+    def _lookup_segments(self, event_id: str, d: str) -> Event | None:
+        """Segment half of :meth:`_lookup` (positional-id routing plus the
+        per-segment sorted-id index) — also the dedup fallback when the
+        recent-id window provably covers the whole tail."""
         if "@" in event_id:
             seg_name, _, row_s = event_id.rpartition("@")
             path = os.path.join(d, seg_name + ".npz")
@@ -562,7 +779,7 @@ class _ColumnarEvents(LEvents):
                 seg = self._segment(path)
                 row = int(row_s)
                 if row < len(seg) and seg.ids is None:
-                    return seg.row_event(row), False
+                    return seg.row_event(row)
         # explicit-id (compacted) segments: match by stored id through the
         # per-segment sorted index — O(log rows) searchsorted per segment
         # instead of a full O(rows) equality scan per point get()/delete().
@@ -576,8 +793,8 @@ class _ColumnarEvents(LEvents):
             sorted_ids, order = index
             pos = int(np.searchsorted(sorted_ids, event_id))
             if pos < sorted_ids.size and sorted_ids[pos] == event_id:
-                return self._segment(path).row_event(int(order[pos])), False
-        return None, False
+                return self._segment(path).row_event(int(order[pos]))
+        return None
 
     def _segment_id_index(
         self, path: str
@@ -1174,6 +1391,11 @@ class StorageClient(BaseStorageClient):
         PIO_STORAGE_SOURCES_<ID>_PATH=/data/pio-events
         PIO_STORAGE_SOURCES_<ID>_SEGMENT_ROWS=1000000   # optional
         PIO_STORAGE_SOURCES_<ID>_FSYNC=false            # optional
+        PIO_STORAGE_SOURCES_<ID>_DEDUP_WINDOW=100000    # optional
+
+    On open, the driver runs a startup recovery sweep (quarantines orphan
+    temp/staging files, replays committed compactions, trims torn tail
+    lines) and reports it via :meth:`recovery_report`.
     """
 
     def __init__(self, config: StorageClientConfig):
@@ -1187,6 +1409,7 @@ class StorageClient(BaseStorageClient):
         )
         fsync = config.properties.get("fsync", "false").lower() == "true"
         cache_segments = config.properties.get("cache_segments")
+        dedup_window = config.properties.get("dedup_window")
         base = os.path.join(os.path.expanduser(path), f"{prefix}_events")
         os.makedirs(base, exist_ok=True)
         self._events = _ColumnarEvents(
@@ -1194,8 +1417,26 @@ class StorageClient(BaseStorageClient):
             cache_segments=(
                 int(cache_segments) if cache_segments is not None else None
             ),
+            dedup_window=(
+                int(dedup_window) if dedup_window is not None else None
+            ),
         )
         self._pevents = _ColumnarPEvents(self._events)
+        # startup recovery: a kill -9 can leave orphan temp files, a torn
+        # commit marker, or a torn tail line — sweep BEFORE any read or
+        # write touches the store, quarantining rather than deleting
+        self._recovery = self._events.sweep_recovery()
+        if self._recovery["quarantined"]:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "columnar startup recovery quarantined %d file(s): %s",
+                len(self._recovery["quarantined"]),
+                ", ".join(self._recovery["quarantined"][:5]),
+            )
+
+    def recovery_report(self) -> dict:
+        return dict(self._recovery)
 
     def get_l_events(self) -> LEvents:
         return self._events

@@ -291,6 +291,20 @@ class _MemEvents(LEvents):
             self._stream(app_id, channel_id)[eid] = event.with_event_id(eid)
             return eid
 
+    def insert_dedup(
+        self, event: Event, app_id: int, channel_id: int | None = None
+    ) -> tuple[str, bool]:
+        """The id-keyed stream dict IS the (process-lifetime) dedup
+        index: membership is exact, checked and inserted under one lock.
+        No durability — this driver holds nothing across restarts."""
+        with self._lock:
+            eid = event.event_id or new_event_id()
+            stream = self._stream(app_id, channel_id)
+            if event.event_id and eid in stream:
+                return eid, True
+            stream[eid] = event.with_event_id(eid)
+            return eid, False
+
     def get(self, event_id: str, app_id: int, channel_id: int | None = None) -> Event | None:
         return self._stream(app_id, channel_id).get(event_id)
 

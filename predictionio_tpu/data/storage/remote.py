@@ -360,7 +360,18 @@ class _Rpc:
             raise StorageError(body["error"])
         return body.get("result")
 
-    def call(self, repo: str, method: str, args: dict) -> Any:
+    def call(
+        self,
+        repo: str,
+        method: str,
+        args: dict,
+        idempotent: bool | None = None,
+    ) -> Any:
+        """``idempotent=None`` derives retryability from the method name
+        (reads retry, writes don't); an explicit True marks a WRITE safe
+        to retry — the event-insert path sets it once every event carries
+        a client/server-stamped id, because the server-side dedup index
+        makes re-sending the same event a no-op."""
         deadline = resilience.current_deadline()
         own = None
         if self._deadline_s > 0:
@@ -448,7 +459,9 @@ class _Rpc:
             return self._policy.run(
                 one_attempt,
                 retryable=(StorageUnavailableError,),
-                idempotent=_is_idempotent(method),
+                idempotent=(
+                    _is_idempotent(method) if idempotent is None else idempotent
+                ),
                 deadline=deadline,
                 on_retry=on_retry,
             )
@@ -722,26 +735,68 @@ class _RemoteLEvents(LEvents):
         )
 
     def insert(self, event: Event, app_id: int, channel_id: int | None = None) -> str:
-        return self._rpc.call(
-            "l_events", "insert",
-            {
-                "event": _event_to_wire(event),
-                "app_id": app_id,
-                "channel_id": channel_id,
-            },
-        )
+        return self.insert_dedup(event, app_id, channel_id)[0]
+
+    def insert_dedup(
+        self, event: Event, app_id: int, channel_id: int | None = None
+    ) -> tuple[str, bool]:
+        """Retry-safe remote event write (the reason PR 2's RetryPolicy
+        can finally cover this path): the event id is stamped HERE, before
+        the wire, so a retried RPC whose first attempt landed but whose
+        response was lost re-sends the SAME id and the server's dedup
+        index turns it into ``duplicate=True`` instead of a double
+        write."""
+        if not event.event_id:
+            from predictionio_tpu.data.event import new_event_id
+
+            event = event.with_event_id(new_event_id())
+        args = {
+            "event": _event_to_wire(event),
+            "app_id": app_id,
+            "channel_id": channel_id,
+        }
+        try:
+            eid, dup = self._rpc.call(
+                "l_events", "insert_dedup", args, idempotent=True
+            )
+        except StorageError as e:
+            if "unknown method" not in str(e):
+                raise
+            # pre-dedup storage server: legacy single-shot insert (the
+            # write is NOT retry-safe there, so no idempotent override)
+            return self._rpc.call("l_events", "insert", args), False
+        return eid, bool(dup)
 
     def insert_batch(
         self, events: Sequence[Event], app_id: int, channel_id: int | None = None
     ) -> list[str]:
-        return self._rpc.call(
-            "l_events", "insert_batch",
-            {
-                "events": [_event_to_wire(e) for e in events],
-                "app_id": app_id,
-                "channel_id": channel_id,
-            },
-        )
+        return [eid for eid, _ in self.insert_batch_dedup(events, app_id, channel_id)]
+
+    def insert_batch_dedup(
+        self, events: Sequence[Event], app_id: int, channel_id: int | None = None
+    ) -> list[tuple[str, bool]]:
+        stamped = []
+        for e in events:
+            if not e.event_id:
+                from predictionio_tpu.data.event import new_event_id
+
+                e = e.with_event_id(new_event_id())
+            stamped.append(e)
+        args = {
+            "events": [_event_to_wire(e) for e in stamped],
+            "app_id": app_id,
+            "channel_id": channel_id,
+        }
+        try:
+            result = self._rpc.call(
+                "l_events", "insert_batch_dedup", args, idempotent=True
+            )
+        except StorageError as e:
+            if "unknown method" not in str(e):
+                raise
+            ids = self._rpc.call("l_events", "insert_batch", args)
+            return [(eid, False) for eid in ids]
+        return [(eid, bool(dup)) for eid, dup in result]
 
     def compact(self, app_id: int, channel_id: int | None = None) -> int:
         """Proxy of the columnar driver's tail compaction; StorageError
@@ -915,6 +970,22 @@ class StorageClient(BaseStorageClient):
 # Server
 # ---------------------------------------------------------------------------
 
+
+def _driver_has_dedup(repo: Any, method: str) -> bool:
+    """Does this LEvents implementation actually dedup, or would it run
+    the base-class default (a plain insert)? ``insert_batch_dedup``'s
+    base default loops ``insert_dedup``, so overriding either makes the
+    batch flavor safe."""
+    cls = type(repo)
+    if getattr(cls, "insert_dedup", None) is not LEvents.insert_dedup:
+        return True
+    return (
+        method == "insert_batch_dedup"
+        and getattr(cls, "insert_batch_dedup", None)
+        is not LEvents.insert_batch_dedup
+    )
+
+
 #: repo name -> (method -> (arg decoder kwargs, result encoder))
 _ENTITY_ARGS = {
     ("apps", "insert"): ("app", _app_from),
@@ -988,8 +1059,9 @@ class StorageRpcService:
         "models": frozenset(("insert", "get", "delete")),
         "l_events": frozenset(
             (
-                "init", "remove", "insert", "insert_batch", "get",
-                "delete", "find", "find_page", "compact",
+                "init", "remove", "insert", "insert_batch", "insert_dedup",
+                "insert_batch_dedup", "get", "delete", "find", "find_page",
+                "compact",
             )
         ),
         "p_events": frozenset(("find", "find_page", "write", "delete")),
@@ -1028,6 +1100,17 @@ class StorageRpcService:
         if method == "compact" and not hasattr(repo, "compact"):
             raise StorageError(
                 "the backing EVENTDATA store has no tail to compact"
+            )
+        if method in ("insert_dedup", "insert_batch_dedup") and not (
+            _driver_has_dedup(repo, method)
+        ):
+            # a driver still on the no-op base default would ACCEPT the
+            # call but store duplicates — answer "unknown method" so the
+            # client falls back to the legacy path and, crucially, stops
+            # treating the write as retry-safe
+            raise StorageError(
+                f"unknown method '{role}.{method}' (backing event store "
+                "has no dedup index)"
             )
         # find_page is a server-layer verb over the repo's find iterator,
         # not an SPI method — resolved after arg decoding below

@@ -35,45 +35,24 @@ from predictionio_tpu.data.storage.base import (
     StorageClientConfig,
     StorageError,
 )
-from predictionio_tpu.data.storage.localfs import _FsModels
+from predictionio_tpu.data.storage.localfs import _FsModels, _pid_alive
 
 __all__ = ["StorageClient"]
 
 
 class _SharedFsModels(_FsModels):
     """Extends the localfs store (same paths/sanitization — a model
-    written by either driver is readable by the other) with the
-    concurrent-multi-host hardening documented above."""
+    written by either driver is readable by the other; the write path,
+    fsync of data + directory entry included, now lives in
+    ``_FsModels.insert``) with the concurrent-multi-host hardening
+    documented above."""
 
-    def __init__(self, base: str, fsync: bool = True):
-        super().__init__(base)
-        self._fsync = fsync
-
-    def insert(self, model: Model) -> None:
-        final = self._path(model.id)
+    def _tmp_path(self, final: str) -> str:
         # host-unique temp name: concurrent writers on different hosts of a
         # shared mount must never collide before the atomic rename
-        tmp = (
+        return (
             f"{final}.tmp.{socket.gethostname()}.{os.getpid()}.{uuid.uuid4().hex[:8]}"
         )
-        try:
-            with open(tmp, "wb") as f:
-                f.write(model.models)
-                if self._fsync:
-                    f.flush()
-                    os.fsync(f.fileno())
-            os.replace(tmp, final)
-            if self._fsync:
-                # persist the rename itself (directory entry) before
-                # reporting success to the trainer
-                dir_fd = os.open(self._base, os.O_RDONLY)
-                try:
-                    os.fsync(dir_fd)
-                finally:
-                    os.close(dir_fd)
-        finally:
-            if os.path.exists(tmp):
-                os.remove(tmp)
 
     def get(self, model_id: str) -> Model | None:
         path = self._path(model_id)
@@ -98,6 +77,30 @@ class _SharedFsModels(_FsModels):
         except FileNotFoundError:
             return False
 
+    def sweep_recovery(self) -> dict:
+        """Like the localfs sweep, but restricted to temps carrying THIS
+        host's name: on a shared mount an unsuffixed ``*.tmp.<host>...``
+        file may be another host's write in flight, and quarantining it
+        would break that host's atomic rename."""
+        report: dict = {"quarantined": [], "notes": []}
+        marker = f".tmp.{socket.gethostname()}."
+        try:
+            names = sorted(os.listdir(self._base))
+        except FileNotFoundError:
+            return report
+        for name in names:
+            if not (name.startswith("pio_model_") and marker in name):
+                continue
+            pid_part = name.split(marker, 1)[1].split(".")[0]
+            if pid_part.isdigit() and _pid_alive(int(pid_part)):
+                continue  # a same-host writer process is still in flight
+            qdir = os.path.join(self._base, "quarantine")
+            os.makedirs(qdir, exist_ok=True)
+            dest = os.path.join(qdir, f"{name}.{uuid.uuid4().hex[:8]}")
+            os.replace(os.path.join(self._base, name), dest)
+            report["quarantined"].append(dest)
+        return report
+
 
 class StorageClient(BaseStorageClient):
     """Shared-mount model driver (``TYPE=sharedfs``; ``PATH`` = directory)."""
@@ -109,6 +112,13 @@ class StorageClient(BaseStorageClient):
             raise StorageError("sharedfs driver requires a PATH property")
         fsync = config.properties.get("fsync", "true").lower() != "false"
         self._models = _SharedFsModels(os.path.expanduser(path), fsync)
+        # NOTE: on a shared mount other hosts may be mid-write, so only
+        # THIS host's orphans are quarantined (the temp-name suffix makes
+        # ownership checkable)
+        self._recovery = self._models.sweep_recovery()
+
+    def recovery_report(self) -> dict:
+        return dict(self._recovery)
 
     def get_models(self) -> ModelsRepo:
         return self._models

@@ -69,6 +69,11 @@ class _Db:
         self.conn = sqlite3.connect(path, check_same_thread=False)
         self.conn.execute("PRAGMA journal_mode=WAL")
         self.conn.execute("PRAGMA synchronous=NORMAL")
+        # writer contention (a second process on the same db file, e.g.
+        # `pio import` beside a live event server) must queue briefly, not
+        # surface as instant `database is locked` OperationalErrors — the
+        # in-process RLock below only serializes THIS process's writers
+        self.conn.execute("PRAGMA busy_timeout=5000")
         self.lock = threading.RLock()
 
     def execute(self, sql: str, params: Sequence[Any] = ()) -> sqlite3.Cursor:
@@ -493,6 +498,56 @@ class _SqlEvents(LEvents):
         )
         return [e.event_id for e in stamped]  # type: ignore[misc]
 
+    def insert_dedup(
+        self, event: Event, app_id: int, channel_id: int | None = None
+    ) -> tuple[str, bool]:
+        """Idempotent insert: the event table's ``id`` PRIMARY KEY is the
+        durable dedup index (no side structure, same commit path —
+        whatever survived a crash IS what dedup checks against). OR
+        IGNORE keeps the first write; rowcount 0 means duplicate."""
+        if not event.event_id:
+            return self.insert(event, app_id, channel_id), False
+        t = self._ensure(app_id, channel_id)
+        cur = self._db.execute(
+            f"INSERT OR IGNORE INTO {t} ({_EV_COLS}) VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?)",
+            self._to_row(event),
+        )
+        return event.event_id, cur.rowcount == 0
+
+    def insert_batch_dedup(
+        self, events: Sequence[Event], app_id: int, channel_id: int | None = None
+    ) -> list[tuple[str, bool]]:
+        t = self._ensure(app_id, channel_id)
+        stamped = [e if e.event_id else e.with_event_id(new_event_id()) for e in events]
+        client_ids = [e.event_id for e in events if e.event_id]
+        with self._db.lock:
+            # one transaction: pre-read which client ids already exist,
+            # then OR IGNORE the whole batch (keeps the single-commit
+            # amortization of the batch route). Intra-batch repeats are
+            # caught by the seen-set below — OR IGNORE keeps the first.
+            existing: set[str] = set()
+            if client_ids:
+                marks = ",".join("?" * len(client_ids))
+                existing = {
+                    r[0]
+                    for r in self._db.conn.execute(
+                        f"SELECT id FROM {t} WHERE id IN ({marks})", client_ids
+                    )
+                }
+            self._db.conn.executemany(
+                f"INSERT OR IGNORE INTO {t} ({_EV_COLS}) VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?)",
+                [self._to_row(e) for e in stamped],
+            )
+            self._db.conn.commit()
+        out: list[tuple[str, bool]] = []
+        seen: set[str] = set()
+        for orig, e in zip(events, stamped):
+            dup = bool(orig.event_id) and (e.event_id in existing or e.event_id in seen)
+            if orig.event_id:
+                seen.add(e.event_id)  # type: ignore[arg-type]
+            out.append((e.event_id, dup))  # type: ignore[arg-type]
+        return out
+
     @staticmethod
     def _to_row(e: Event) -> tuple:
         return (
@@ -701,6 +756,15 @@ class StorageClient(BaseStorageClient):
 
     def get_p_events(self) -> PEvents:
         return self._pevents
+
+    def recovery_report(self) -> dict:
+        return {
+            "quarantined": [],
+            "notes": [
+                "sqlite WAL: torn transactions roll back natively on open; "
+                "no file-level sweep needed"
+            ],
+        }
 
     def close(self) -> None:
         self._db.close()

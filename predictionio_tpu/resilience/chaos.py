@@ -1,0 +1,555 @@
+"""Kill-9 chaos harness for the ingestion path (``pio chaos-ingest``).
+
+Nothing in a test suite proves crash safety like actually crashing: this
+harness spawns a **real event-server subprocess** on a scratch storage
+directory, drives concurrent retrying writers against it over real HTTP,
+SIGKILLs the server at seeded-random points mid-traffic (including while
+a deliberately torn request body is on the wire), restarts it, and at
+the end verifies the three invariants the rest of this repo's
+crash-safety work exists to provide:
+
+1. **zero acked loss** — every event the server acknowledged (HTTP 201)
+   before any kill is present after the final restart;
+2. **zero duplicates** — retried writes (same client ``eventId``) never
+   double-count: the storage dedup index absorbs them;
+3. **clean recovery** — the startup sweep leaves no unquarantined torn
+   files (``*.tmp`` / ``*.pending``) anywhere in the store.
+
+A final **drain phase** SIGTERMs a server started with
+``--drain-deadline-s`` while writers are in flight and asserts it exits
+0 within the deadline with no raw 500s (late arrivals get clean 503 +
+``Retry-After``).
+
+Writer-side faults are scheduled through the deterministic
+:class:`~predictionio_tpu.resilience.faults.FaultInjector` — just before
+each kill the injector aborts a burst of writer calls client-side, so
+the "request abandoned exactly at the kill point" path is exercised on
+every cycle, not only when the race happens to land.
+
+Kill cycles and verdicts feed the ``chaos_ingest`` bench section (and
+its CI smoke guard: >= 3 kill cycles, ``ackedLost == 0``,
+``duplicates == 0``).
+
+Stdlib-only by contract (the resilience package's piolint manifest
+entry): the harness drives the server over the wire and inspects the
+store through the filesystem and the REST API — it never imports the
+storage layer it is trying to catch lying.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import random
+import shutil
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Any
+
+from predictionio_tpu.resilience.faults import FaultError, FaultInjector
+
+__all__ = ["ChaosConfig", "ChaosError", "run_chaos_ingest"]
+
+_ACCESS_KEY = "chaos-ingest-key"
+_APP_NAME = "chaosapp"
+
+
+class ChaosError(RuntimeError):
+    """The harness itself could not run (setup/spawn failure) — distinct
+    from a chaos verdict, which is reported, not raised."""
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaosConfig:
+    """Knobs of one chaos run (CLI: ``pio chaos-ingest``)."""
+
+    cycles: int = 3  # SIGKILL/restart cycles
+    writers: int = 4
+    events_per_writer: int = 120  # across the whole run, per writer
+    backend: str = "sqlite"  # sqlite | columnar (columnar forces FSYNC=true)
+    seed: int = 0
+    drain_deadline_s: float = 5.0  # the SIGTERM-under-load phase
+    startup_timeout_s: float = 60.0
+    #: overall wall-clock budget; expiry fails the run rather than hanging CI
+    total_timeout_s: float = 300.0
+    base_dir: str | None = None  # None = fresh tempdir
+    keep_dir: bool = False
+
+    def __post_init__(self) -> None:
+        if self.backend not in ("sqlite", "columnar"):
+            raise ValueError("backend must be 'sqlite' or 'columnar'")
+        if self.cycles < 1 or self.writers < 1 or self.events_per_writer < 1:
+            raise ValueError("cycles, writers, events_per_writer must be >= 1")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class _ServerProc:
+    """One event-server subprocess on a fixed port + scratch storage env."""
+
+    def __init__(self, env: dict, port: int, extra_args: tuple[str, ...] = ()):
+        self.port = port
+        self.proc = subprocess.Popen(
+            [
+                sys.executable, "-m", "predictionio_tpu.tools.console",
+                "eventserver", "--ip", "127.0.0.1", "--port", str(port),
+                *extra_args,
+            ],
+            env=env,
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        )
+
+    def wait_ready(self, timeout_s: float) -> float:
+        """Poll ``/readyz`` until 200; returns seconds to readiness."""
+        t0 = time.monotonic()
+        url = f"http://127.0.0.1:{self.port}/readyz"
+        while time.monotonic() - t0 < timeout_s:
+            if self.proc.poll() is not None:
+                raise ChaosError(
+                    f"event server exited rc={self.proc.returncode} before ready"
+                )
+            try:
+                with urllib.request.urlopen(url, timeout=2) as resp:
+                    if resp.status == 200:
+                        return time.monotonic() - t0
+            except Exception:
+                pass
+            time.sleep(0.05)
+        raise ChaosError(f"event server not ready within {timeout_s:g}s")
+
+    def kill9(self) -> None:
+        self.proc.send_signal(signal.SIGKILL)
+        self.proc.wait(timeout=30)
+
+    def sigterm(self) -> None:
+        self.proc.send_signal(signal.SIGTERM)
+
+    def stop(self) -> None:
+        if self.proc.poll() is None:
+            self.proc.kill()
+            self.proc.wait(timeout=30)
+
+
+class _Writers:
+    """Concurrent retrying writers. Each event carries a deterministic
+    client ``eventId``; any transport failure or non-201 answer is
+    retried with the SAME id — the idempotent-ingestion contract is what
+    makes this loop safe, and this harness is what proves it."""
+
+    def __init__(self, port: int, n_writers: int, per_writer: int,
+                 injector: FaultInjector, stop: threading.Event, seed: int):
+        self.port = port
+        self.injector = injector
+        self.stop = stop
+        self.acked: dict[str, int] = {}  # eventId -> ack count (1 expected)
+        self.duplicate_acks = 0  # 201s with "duplicate": true (retries absorbed)
+        #: an already-acked id re-sent WITHOUT the duplicate flag coming
+        #: back means the server double-stored it — the core violation
+        self.dedup_violations = 0
+        self._lock = threading.Lock()
+        self._threads = [
+            threading.Thread(
+                target=self._run, name=f"chaos-writer-{w}",
+                args=(w, per_writer, random.Random(seed * 1000 + w)),
+                daemon=True,
+            )
+            for w in range(n_writers)
+        ]
+
+    def start(self) -> None:
+        for t in self._threads:
+            t.start()
+
+    def done(self) -> bool:
+        return all(not t.is_alive() for t in self._threads)
+
+    def acked_count(self) -> int:
+        with self._lock:
+            return len(self.acked)
+
+    def join(self, timeout_s: float) -> bool:
+        deadline = time.monotonic() + timeout_s
+        for t in self._threads:
+            t.join(timeout=max(0.0, deadline - time.monotonic()))
+        return self.done()
+
+    def _post(self, event_id: str, payload: bytes) -> dict:
+        # the injector sits on the CLIENT side: a scheduled fault aborts
+        # this call exactly where a kill-9'd connection would
+        self.injector.before_call("writer-post")
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{self.port}/events.json?accessKey={_ACCESS_KEY}",
+            data=payload,
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            return json.loads(resp.read())
+
+    def _run(self, writer: int, per_writer: int, rng: random.Random) -> None:
+        for i in range(per_writer):
+            event_id = f"w{writer}-e{i:05d}"
+            payload = json.dumps(
+                {
+                    "eventId": event_id,
+                    "event": "rate",
+                    "entityType": "user",
+                    "entityId": f"u{writer}",
+                    "targetEntityType": "item",
+                    "targetEntityId": f"i{i % 97}",
+                    "properties": {"rating": float(1 + i % 5)},
+                }
+            ).encode()
+            while not self.stop.is_set():
+                try:
+                    body = self._post(event_id, payload)
+                except (urllib.error.URLError, urllib.error.HTTPError,
+                        ConnectionError, TimeoutError, OSError, FaultError):
+                    # server down / mid-kill / injected abort: back off a
+                    # touch and re-send the SAME eventId
+                    time.sleep(0.05 + rng.random() * 0.15)
+                    continue
+                if body.get("eventId"):
+                    with self._lock:
+                        self.acked[event_id] = self.acked.get(event_id, 0) + 1
+                        if body.get("duplicate"):
+                            self.duplicate_acks += 1
+                    if rng.random() < 0.15:
+                        # deliberate retransmit of an ALREADY-acked event:
+                        # the lost-ack retry in miniature, forced often
+                        # enough to prove dedup rather than hoping the
+                        # kill window produces it. Best-effort — a kill
+                        # racing the probe is fine, a missing duplicate
+                        # flag on a delivered answer is not.
+                        try:
+                            again = self._post(event_id, payload)
+                        except Exception:
+                            pass
+                        else:
+                            with self._lock:
+                                if again.get("duplicate"):
+                                    self.duplicate_acks += 1
+                                else:
+                                    self.dedup_violations += 1
+                    break
+                time.sleep(0.05 + rng.random() * 0.15)
+            else:
+                return  # harness timed out; report what was acked so far
+
+
+def _torn_request(port: int, event_id: str) -> None:
+    """Send a request whose body stops halfway (Content-Length promises
+    more) and abandon the socket — the classic torn write a crashing
+    client (or a server kill mid-read) produces. The server must never
+    ack it, and no storage garbage may survive it unquarantined."""
+    body = json.dumps(
+        {
+            "eventId": event_id,
+            "event": "rate",
+            "entityType": "user",
+            "entityId": "torn",
+            "targetEntityType": "item",
+            "targetEntityId": "torn",
+        }
+    ).encode()
+    try:
+        with socket.create_connection(("127.0.0.1", port), timeout=2) as s:
+            head = (
+                f"POST /events.json?accessKey={_ACCESS_KEY} HTTP/1.1\r\n"
+                f"Host: 127.0.0.1:{port}\r\n"
+                "Content-Type: application/json\r\n"
+                f"Content-Length: {len(body)}\r\n\r\n"
+            ).encode()
+            s.sendall(head + body[: len(body) // 2])
+            # abandon mid-body; RST on close
+    except OSError:
+        pass  # server may already be dead — the tear still happened
+
+
+def _storage_env(base: str, backend: str) -> dict:
+    env = dict(os.environ)
+    env.pop("PIO_JAX_PLATFORMS", None)
+    env["JAX_PLATFORMS"] = "cpu"  # a sitecustomize-preloaded jax stays on CPU
+    # children must resolve predictionio_tpu regardless of the caller's
+    # cwd or install state (same injection `pio run` performs)
+    pkg_root = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    env["PYTHONPATH"] = (
+        pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+    ).rstrip(os.pathsep)
+    env["PIO_FS_BASEDIR"] = str(base)
+    env["PIO_STORAGE_REPOSITORIES_METADATA_SOURCE"] = "CHAOS_META"
+    env["PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE"] = "CHAOS_FS"
+    env["PIO_STORAGE_SOURCES_CHAOS_META_TYPE"] = "sqlite"
+    env["PIO_STORAGE_SOURCES_CHAOS_META_PATH"] = os.path.join(base, "meta.db")
+    env["PIO_STORAGE_SOURCES_CHAOS_FS_TYPE"] = "localfs"
+    env["PIO_STORAGE_SOURCES_CHAOS_FS_PATH"] = os.path.join(base, "models")
+    if backend == "sqlite":
+        env["PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE"] = "CHAOS_META"
+    else:
+        env["PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE"] = "CHAOS_COL"
+        env["PIO_STORAGE_SOURCES_CHAOS_COL_TYPE"] = "columnar"
+        env["PIO_STORAGE_SOURCES_CHAOS_COL_PATH"] = os.path.join(base, "events")
+        # "acked == durable" is only a promise when the tail is fsync'd
+        env["PIO_STORAGE_SOURCES_CHAOS_COL_FSYNC"] = "true"
+    return env
+
+
+def _setup_app(env: dict) -> None:
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "predictionio_tpu.tools.console",
+            "app", "new", _APP_NAME, "--access-key", _ACCESS_KEY,
+        ],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    if proc.returncode != 0:
+        raise ChaosError(f"app setup failed: {proc.stderr[-500:]}")
+
+
+def _fetch_all_events(port: int) -> list[dict]:
+    url = (
+        f"http://127.0.0.1:{port}/events.json?accessKey={_ACCESS_KEY}&limit=-1"
+    )
+    with urllib.request.urlopen(url, timeout=30) as resp:
+        return json.loads(resp.read())
+
+
+def _unquarantined_torn_files(base: str) -> list[str]:
+    """Any ``*.tmp`` / ``*.pending`` file outside a ``quarantine/`` dir
+    is a torn write the recovery sweep missed."""
+    bad: list[str] = []
+    for root, dirs, files in os.walk(base):
+        if "quarantine" in root.split(os.sep):
+            continue
+        for name in files:
+            if name.endswith((".tmp", ".pending", ".pending.tmp", ".repair")):
+                bad.append(os.path.join(root, name))
+    return sorted(bad)
+
+
+def _drain_phase(env: dict, cfg: ChaosConfig, rng: random.Random) -> dict:
+    """SIGTERM under load: a fresh server with ``--drain-deadline-s``
+    gets concurrent writers, then SIGTERM mid-traffic. Verdict: exit 0
+    within the deadline (+ grace), every response a 201 or a clean 503,
+    zero raw 500s / dropped connections after the ack."""
+    port = _free_port()
+    server = _ServerProc(
+        env, port, extra_args=("--drain-deadline-s", str(cfg.drain_deadline_s))
+    )
+    statuses: list[int] = []
+    lock = threading.Lock()
+    stop = threading.Event()
+
+    def drain_writer(w: int) -> None:
+        i = 0
+        while not stop.is_set():
+            i += 1
+            payload = json.dumps(
+                {
+                    "eventId": f"drain-w{w}-e{i}",
+                    "event": "rate",
+                    "entityType": "user",
+                    "entityId": f"d{w}",
+                    "targetEntityType": "item",
+                    "targetEntityId": f"i{i % 7}",
+                }
+            ).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/events.json?accessKey={_ACCESS_KEY}",
+                data=payload,
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=10) as resp:
+                    status = resp.status
+            except urllib.error.HTTPError as e:
+                status = e.code
+            except OSError:
+                # listener already gone (post-drain) — not a protocol
+                # violation, the request was never admitted
+                break
+            with lock:
+                statuses.append(status)
+            time.sleep(0.005)
+
+    try:
+        server.wait_ready(cfg.startup_timeout_s)
+        writers = [
+            threading.Thread(target=drain_writer, args=(w,), daemon=True)
+            for w in range(cfg.writers)
+        ]
+        for t in writers:
+            t.start()
+        time.sleep(0.3 + rng.random() * 0.2)  # real traffic in flight
+        t_term = time.monotonic()
+        server.sigterm()
+        try:
+            exit_code = server.proc.wait(
+                timeout=cfg.drain_deadline_s + cfg.startup_timeout_s
+            )
+        except subprocess.TimeoutExpired:
+            server.stop()
+            return {"exitCode": None, "error": "drain never exited"}
+        exit_seconds = time.monotonic() - t_term
+        stop.set()
+        for t in writers:
+            t.join(timeout=10)
+    finally:
+        stop.set()
+        server.stop()
+    with lock:
+        counts = {str(s): statuses.count(s) for s in sorted(set(statuses))}
+        raw_500s = sum(1 for s in statuses if s >= 500 and s != 503)
+    return {
+        "exitCode": exit_code,
+        "exitSeconds": round(exit_seconds, 3),
+        "withinDeadline": exit_seconds <= cfg.drain_deadline_s + 2.0,
+        "responses": counts,
+        "raw500s": raw_500s,
+        "drainDeadlineSeconds": cfg.drain_deadline_s,
+    }
+
+
+def run_chaos_ingest(cfg: ChaosConfig) -> dict:
+    """Run the full harness; returns the report dict (``report["ok"]`` is
+    the overall verdict — the CLI exit code and the bench smoke guard key
+    off the individual invariants)."""
+    base = cfg.base_dir or tempfile.mkdtemp(prefix="pio_chaos_")
+    os.makedirs(base, exist_ok=True)
+    env = _storage_env(base, cfg.backend)
+    rng = random.Random(cfg.seed)
+    injector = FaultInjector()
+    t_start = time.monotonic()
+    report: dict[str, Any] = {
+        "backend": cfg.backend,
+        "cycles": cfg.cycles,
+        "writers": cfg.writers,
+        "eventsPerWriter": cfg.events_per_writer,
+        "seed": cfg.seed,
+    }
+    port = _free_port()
+    server: _ServerProc | None = None
+    stop = threading.Event()
+    try:
+        _setup_app(env)
+        server = _ServerProc(env, port)
+        cold_start = server.wait_ready(cfg.startup_timeout_s)
+        writers = _Writers(
+            port, cfg.writers, cfg.events_per_writer, injector, stop, cfg.seed
+        )
+        writers.start()
+        recovery_s: list[float] = []
+        kills = 0
+        total = cfg.writers * cfg.events_per_writer
+        for cycle in range(cfg.cycles):
+            # kill points are keyed to writer PROGRESS, not wall time, so
+            # every kill is guaranteed to land mid-stream (with work both
+            # behind it — acked events that must survive — and ahead of
+            # it — events whose retries must converge after restart). The
+            # seeded jitter moves each point around its progress anchor.
+            target = max(
+                1,
+                int(total * (cycle + 1) / (cfg.cycles + 1))
+                - rng.randrange(max(1, total // (4 * cfg.cycles))),
+            )
+            while (
+                writers.acked_count() < target
+                and not writers.done()
+                and time.monotonic() - t_start < cfg.total_timeout_s
+            ):
+                time.sleep(0.01)
+            # abort a burst of in-flight writer calls client-side at the
+            # exact kill point (deterministic via the injector schedule)
+            # and put one torn half-request on the wire
+            injector.fail_next(cfg.writers)
+            _torn_request(port, f"torn-c{cycle}")
+            server.kill9()
+            kills += 1
+            time.sleep(0.05 + rng.random() * 0.2)  # writers bang on a dead port
+            server = _ServerProc(env, port)
+            recovery_s.append(server.wait_ready(cfg.startup_timeout_s))
+        # final convergence: writers finish acking everything
+        budget = cfg.total_timeout_s - (time.monotonic() - t_start)
+        finished = writers.join(max(5.0, budget))
+        stop.set()
+
+        expected = {
+            f"w{w}-e{i:05d}"
+            for w in range(cfg.writers)
+            for i in range(cfg.events_per_writer)
+        }
+        acked = dict(writers.acked)
+        stored = _fetch_all_events(port)
+        stored_counts: dict[str, int] = {}
+        for ev in stored:
+            eid = ev.get("eventId") or ""
+            stored_counts[eid] = stored_counts.get(eid, 0) + 1
+        acked_lost = sorted(e for e in acked if stored_counts.get(e, 0) == 0)
+        duplicates = sorted(
+            e for e, n in stored_counts.items() if n > 1
+        )
+        torn_acked = [e for e in stored_counts if e.startswith("torn-")]
+        torn_files = _unquarantined_torn_files(base)
+        report.update(
+            killCycles=kills,
+            writersFinished=finished,
+            ackedTotal=len(acked),
+            ackedExpected=len(expected),
+            ackedLost=len(acked_lost),
+            ackedLostIds=acked_lost[:20],
+            duplicates=len(duplicates),
+            duplicateIds=duplicates[:20],
+            duplicateAcksAbsorbed=writers.duplicate_acks,
+            dedupViolations=writers.dedup_violations,
+            tornRequestsStored=len(torn_acked),
+            unquarantinedTornFiles=len(torn_files),
+            unquarantinedTornFilePaths=torn_files[:20],
+            coldStartSeconds=round(cold_start, 3),
+            recoverySeconds=[round(s, 3) for s in recovery_s],
+            meanRecoverySeconds=round(sum(recovery_s) / len(recovery_s), 3)
+            if recovery_s
+            else None,
+            injector=injector.to_json(),
+        )
+    finally:
+        stop.set()
+        if server is not None:
+            server.stop()
+    report["drain"] = _drain_phase(env, cfg, rng)
+    if not cfg.keep_dir and cfg.base_dir is None:
+        shutil.rmtree(base, ignore_errors=True)
+    else:
+        report["storageDir"] = base
+    drain = report["drain"]
+    report["ok"] = bool(
+        report.get("killCycles", 0) >= cfg.cycles
+        and report.get("writersFinished")
+        and report.get("ackedLost") == 0
+        and report.get("duplicates") == 0
+        and report.get("dedupViolations") == 0
+        and report.get("tornRequestsStored") == 0
+        and report.get("unquarantinedTornFiles") == 0
+        and drain.get("exitCode") == 0
+        and drain.get("raw500s") == 0
+        and drain.get("withinDeadline")
+    )
+    return report

@@ -120,6 +120,16 @@ def build_parser() -> argparse.ArgumentParser:
             help="PEM private key for https (default: $PIO_SSL_KEY)",
         )
 
+    def add_lifecycle_flags(sp):
+        sp.add_argument(
+            "--drain-deadline-s", type=float, default=0.0, metavar="S",
+            help="graceful drain on SIGTERM/SIGINT: stop accepting (503 + "
+            "Retry-After, /readyz flips unready), finish in-flight "
+            "requests within S seconds, flush storage, exit 0; a second "
+            "signal force-quits. 0 (default) keeps immediate exit "
+            "(docs/operations.md)",
+        )
+
     # ---- deploy
     deploy = sub.add_parser("deploy", help="serve the latest trained instance")
     deploy.add_argument("--engine-json", default="engine.json")
@@ -266,6 +276,7 @@ def build_parser() -> argparse.ArgumentParser:
         "event server again",
     )
     add_ssl_flags(deploy)
+    add_lifecycle_flags(deploy)
 
     # ---- undeploy
     und = sub.add_parser(
@@ -302,18 +313,21 @@ def build_parser() -> argparse.ArgumentParser:
     es.add_argument("--port", type=int, default=7070)
     es.add_argument("--stats", action="store_true")
     add_ssl_flags(es)
+    add_lifecycle_flags(es)
 
     # ---- dashboard
     db = sub.add_parser("dashboard", help="start the evaluation dashboard")
     db.add_argument("--ip", default="127.0.0.1")
     db.add_argument("--port", type=int, default=9000)
     add_ssl_flags(db)
+    add_lifecycle_flags(db)
 
     # ---- adminserver
     adm = sub.add_parser("adminserver", help="start the admin REST server")
     adm.add_argument("--ip", default="127.0.0.1")
     adm.add_argument("--port", type=int, default=7071)
     add_ssl_flags(adm)
+    add_lifecycle_flags(adm)
 
     # ---- template
     tpl = sub.add_parser("template", help="built-in engine templates")
@@ -341,6 +355,34 @@ def build_parser() -> argparse.ArgumentParser:
         help="shared secret clients must present (default: $PIO_STORAGE_SERVER_SECRET)",
     )
     add_ssl_flags(ss)
+    add_lifecycle_flags(ss)
+
+    # ---- chaos-ingest (predictionio_tpu.resilience.chaos)
+    ch = sub.add_parser(
+        "chaos-ingest",
+        help="crash-safety drill: SIGKILL a real event-server subprocess "
+        "under concurrent retrying writers and verify exactly-once "
+        "ingestion, clean recovery, and graceful drain",
+    )
+    ch.add_argument("--cycles", type=int, default=3, help="SIGKILL/restart cycles")
+    ch.add_argument("--writers", type=int, default=4, help="concurrent writer threads")
+    ch.add_argument(
+        "--events", type=int, default=120,
+        help="events per writer across the whole run",
+    )
+    ch.add_argument(
+        "--backend", choices=("sqlite", "columnar"), default="sqlite",
+        help="EVENTDATA backend under test (columnar runs with FSYNC=true)",
+    )
+    ch.add_argument("--seed", type=int, default=0, help="kill-schedule RNG seed")
+    ch.add_argument(
+        "--drain-deadline-s", type=float, default=5.0,
+        help="drain deadline for the final SIGTERM-under-load phase",
+    )
+    ch.add_argument(
+        "--keep", action="store_true",
+        help="keep the scratch storage directory for inspection",
+    )
 
     # ---- batchpredict
     bp = sub.add_parser("batchpredict", help="bulk predictions from a query file")
@@ -449,6 +491,32 @@ def _setup_compilation_cache() -> None:
         # jax reads these at import; operator-set JAX_* values win
         os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", cache_dir)
         os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1.0")
+
+
+def _lifecycle_from_args(args):
+    """Opt-in :class:`~predictionio_tpu.api.lifecycle.DrainManager` from
+    ``--drain-deadline-s``. 0 (the default) returns None — signals keep
+    their historical immediate-exit behavior, guarded by
+    tests/test_ci_guards.py. When enabled, SIGTERM/SIGINT handlers are
+    installed here (console main runs on the main thread, a signal-API
+    requirement) and the process-wide storage flush is registered as the
+    final drain hook; the served service's own ``drain`` hook (e.g. the
+    query server's batcher close) is discovered by the HTTP wrapper and
+    runs before it."""
+    deadline = getattr(args, "drain_deadline_s", 0.0)
+    if not deadline or deadline <= 0:
+        return None
+    from predictionio_tpu import resilience
+    from predictionio_tpu.api.lifecycle import DrainManager
+    from predictionio_tpu.data.storage import Storage
+
+    lifecycle = DrainManager(deadline)
+    lifecycle.install_signals()
+    lifecycle.add_drain_hook(Storage.close)
+    # drain state (in-flight count, rejections) joins the resilience
+    # section of GET /stats.json on servers that serve one
+    resilience.register_stats("lifecycle", lifecycle)
+    return lifecycle
 
 
 def _ssl_from_args(args):
@@ -629,6 +697,7 @@ def main(argv: list[str] | None = None) -> int:
             serve(
                 service.dispatch, args.ip, args.port,
                 ssl_context=_ssl_from_args(args), ready_callback=wire_stop,
+                lifecycle=_lifecycle_from_args(args),
             )
         elif cmd == "undeploy":
             commands.undeploy(
@@ -669,7 +738,11 @@ def main(argv: list[str] | None = None) -> int:
 
             service = EventService(stats=args.stats)
             print(f"Event Server is listening on {args.ip}:{args.port}")
-            serve(service.dispatch, args.ip, args.port, ssl_context=_ssl_from_args(args))
+            serve(
+                service.dispatch, args.ip, args.port,
+                ssl_context=_ssl_from_args(args),
+                lifecycle=_lifecycle_from_args(args),
+            )
         elif cmd == "dashboard":
             from predictionio_tpu.api.http import serve
             from predictionio_tpu.tools.dashboard import DashboardService
@@ -678,6 +751,7 @@ def main(argv: list[str] | None = None) -> int:
             serve(
                 DashboardService().dispatch, args.ip, args.port,
                 ssl_context=_ssl_from_args(args),
+                lifecycle=_lifecycle_from_args(args),
             )
         elif cmd == "adminserver":
             from predictionio_tpu.api.http import serve
@@ -687,6 +761,7 @@ def main(argv: list[str] | None = None) -> int:
             serve(
                 AdminService().dispatch, args.ip, args.port,
                 ssl_context=_ssl_from_args(args),
+                lifecycle=_lifecycle_from_args(args),
             )
         elif cmd == "template":
             if args.template_command == "list":
@@ -710,6 +785,7 @@ def main(argv: list[str] | None = None) -> int:
             serve(
                 StorageRpcService(secret=secret).dispatch, args.ip, args.port,
                 ssl_context=_ssl_from_args(args),
+                lifecycle=_lifecycle_from_args(args),
             )
         elif cmd == "batchpredict":
             from predictionio_tpu.tools.batchpredict import run_batch_predict
@@ -773,6 +849,27 @@ def main(argv: list[str] | None = None) -> int:
                     )
                 print(summary)
             return 0 if res.ok else 1
+        elif cmd == "chaos-ingest":
+            # spawns real event-server subprocesses and SIGKILLs them;
+            # stdlib-only harness (docs/operations.md "Crash safety")
+            from predictionio_tpu.resilience.chaos import (
+                ChaosConfig,
+                run_chaos_ingest,
+            )
+
+            report = run_chaos_ingest(
+                ChaosConfig(
+                    cycles=args.cycles,
+                    writers=args.writers,
+                    events_per_writer=args.events,
+                    backend=args.backend,
+                    seed=args.seed,
+                    drain_deadline_s=args.drain_deadline_s,
+                    keep_dir=args.keep,
+                )
+            )
+            print(json.dumps(report, indent=2))
+            return 0 if report["ok"] else 1
         elif cmd == "upgrade":
             print(
                 "predictionio_tpu is a Python package: upgrade with your "

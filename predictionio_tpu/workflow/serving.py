@@ -734,6 +734,14 @@ class QueryService:
         if self.batcher is not None:
             self.batcher.close()
 
+    def drain(self) -> None:
+        """Graceful-shutdown hook, auto-discovered by the HTTP wrapper
+        (``api/lifecycle.py``): runs after in-flight requests completed,
+        so closing the batcher here releases its dispatcher thread and
+        answers anything still queued with a clean 503 instead of
+        abandoning it mid-shutdown."""
+        self.close()
+
     # ------------------------------------------------------------ dispatch
     def dispatch(
         self,

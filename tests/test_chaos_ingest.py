@@ -1,0 +1,47 @@
+"""The kill-9 chaos harness (ISSUE 5 tentpole, piece 4) — a compact run
+as a tier-1 test. The full 3-cycle acceptance drill runs in `bench.py
+--smoke` (asserted by tests/test_ci_guards.py); this direct run keeps a
+focused failure signal when the harness itself regresses, plus unit
+checks on its config validation.
+
+Real subprocesses, real SIGKILL: only the scale is reduced.
+"""
+
+import pytest
+
+from predictionio_tpu.resilience.chaos import ChaosConfig, ChaosError, run_chaos_ingest
+
+
+def test_chaos_config_validation():
+    with pytest.raises(ValueError, match="backend"):
+        ChaosConfig(backend="hbase")
+    with pytest.raises(ValueError, match=">= 1"):
+        ChaosConfig(cycles=0)
+
+
+def test_chaos_ingest_small_run_holds_invariants(tmp_path):
+    report = run_chaos_ingest(
+        ChaosConfig(
+            cycles=2,
+            writers=2,
+            events_per_writer=25,
+            seed=11,
+            base_dir=str(tmp_path / "chaos"),
+            keep_dir=True,  # under pytest's tmp_path; inspectable on failure
+        )
+    )
+    assert report["killCycles"] == 2
+    assert report["writersFinished"] is True
+    assert report["ackedTotal"] == 50
+    assert report["ackedLost"] == 0, report["ackedLostIds"]
+    assert report["duplicates"] == 0, report["duplicateIds"]
+    assert report["dedupViolations"] == 0
+    assert report["tornRequestsStored"] == 0
+    assert report["unquarantinedTornFiles"] == 0, (
+        report["unquarantinedTornFilePaths"]
+    )
+    drain = report["drain"]
+    assert drain["exitCode"] == 0
+    assert drain["raw500s"] == 0
+    assert drain["withinDeadline"] is True
+    assert report["ok"] is True

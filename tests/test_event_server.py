@@ -148,7 +148,7 @@ class TestEventRoutes:
         def boom(*a, **k):
             raise RuntimeError("disk on fire")
 
-        monkeypatch.setattr(type(events_store), "insert_batch", boom)
+        monkeypatch.setattr(type(events_store), "insert_batch_dedup", boom)
         batch = [EV, dict(EV, event="$badname"), dict(EV, entityId="u9")]
         r = svc.dispatch("POST", "/batch/events.json", {"accessKey": key}, batch)
         assert r.status == 200

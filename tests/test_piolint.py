@@ -463,6 +463,56 @@ def test_pio402_bare_except():
     assert _codes("predictionio_tpu/api/x.py", ok) == []
 
 
+_FSYNCLESS = """\
+import os
+
+class Models:
+    def insert(self, path, data):
+        with open(path + ".tmp", "wb") as f:
+            f.write(data)
+        os.replace(path + ".tmp", path)
+"""
+
+
+def test_pio403_fsyncless_replace():
+    # the exact pattern satellite 1 fixed in localfs.py
+    assert _codes("predictionio_tpu/data/storage/x.py", _FSYNCLESS) == ["PIO403"]
+    # scoped to data/storage/: elsewhere atomic-replace without fsync is
+    # a judgment call, not a durability contract
+    assert _codes("predictionio_tpu/api/x.py", _FSYNCLESS) == []
+    # an os.fsync between write and replace satisfies the rule
+    synced = _FSYNCLESS.replace(
+        "            f.write(data)\n",
+        "            f.write(data)\n            os.fsync(f.fileno())\n",
+    )
+    assert _codes("predictionio_tpu/data/storage/x.py", synced) == []
+    # a class exposing an fsync toggle is exempt (operator's choice)
+    toggled = _FSYNCLESS.replace(
+        "class Models:\n",
+        "class Models:\n    def __init__(self, fsync=True):\n"
+        "        self._fsync = fsync\n",
+    )
+    assert _codes("predictionio_tpu/data/storage/x.py", toggled) == []
+    # module-level functions (no class, no toggle possible) are checked
+    flat = """\
+    import os
+
+    def save(path, data):
+        with open(path + ".tmp", "wb") as f:
+            f.write(data)
+        os.replace(path + ".tmp", path)
+    """
+    assert _codes("predictionio_tpu/data/storage/x.py", flat) == ["PIO403"]
+    # read-only open + replace (no write) is not the pattern
+    readonly = flat.replace('"wb"', '"rb"').replace("f.write(data)", "f.read()")
+    assert _codes("predictionio_tpu/data/storage/x.py", readonly) == []
+    suppressed = _FSYNCLESS.replace(
+        "        os.replace(path + \".tmp\", path)",
+        "        os.replace(path + \".tmp\", path)  # piolint: disable=PIO403",
+    )
+    assert _codes("predictionio_tpu/data/storage/x.py", suppressed) == []
+
+
 # ---------------------------------------------------------------------------
 # Baseline mechanics
 # ---------------------------------------------------------------------------
