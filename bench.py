@@ -691,6 +691,7 @@ def _bench_batchpredict(on_accel: bool) -> dict:
 
         out = {
             "catalog_items": num_items,
+            "catalog_users": num_users,
             "host_path": run_one(False),
         }
         try:
@@ -895,6 +896,10 @@ def _bench_serving_concurrent(n_clients: int, per_client: int) -> dict:
         }
         return {
             "concurrency": n_clients,
+            # explicit catalog axis so BENCH_r06+ can plot q/s-vs-items
+            # regression across rounds (ISSUE 6 satellite)
+            "catalog_items": num_items,
+            "catalog_users": num_users,
             "max_batch_size": max_batch,
             "max_batch_delay_ms": delay_ms,
             "per_request_baseline": baseline,
@@ -1120,8 +1125,8 @@ def _bench_serving_cache(n_clients: int, per_client: int) -> dict:
         return {
             "concurrency": n_clients,
             "zipf_a": zipf_a,
-            "users": num_users,
             "catalog_items": num_items,
+            "catalog_users": num_users,
             "pin_model": pin_model,
             "cache_off": off,
             "cache_on": on,
@@ -1523,7 +1528,13 @@ def _bench_serving(n_requests: int) -> dict:
                 "served_from": served_from,
             }
 
-        out = {"host_path": run_one(False)}
+        out = {
+            # explicit catalog axis (ISSUE 6 satellite): q/s-vs-items is
+            # the regression curve approximate retrieval bends
+            "catalog_items": num_items,
+            "catalog_users": num_users,
+            "host_path": run_one(False),
+        }
         try:
             out["device_path"] = run_one(True)
         except Exception as e:  # device path must not sink the whole bench
@@ -1657,6 +1668,138 @@ def _bench_chaos_ingest(cycles: int, writers: int, events: int) -> dict:
     return report
 
 
+def _bench_ann_retrieval() -> dict:
+    """Catalog-size sweep: exact full-catalog top-K vs the two-stage IVF
+    kernel (ISSUE 6 — approximate retrieval so per-query cost stops
+    scaling with catalog size).
+
+    Per sweep point: a clustered synthetic catalog of unit-norm vectors
+    (mixture of Gaussians — factor matrices are clustered in practice,
+    which is the premise IVF exploits; on uniform random vectors NO
+    inverted-file method can beat the scanned fraction), an IVF index at
+    the auto ``nlist ~ sqrt(items)``, then the same query batches
+    through the exact batched kernel and the IVF kernel. Reports q/s,
+    per-dispatch p50/p99, measured recall@10 / recall@100 against the
+    exact ground truth, and the scored fraction of the catalog. A
+    separate correctness probe asserts the ``nprobe == nlist`` mode is
+    bit-identical to the exact batch top-K (ids AND scores)."""
+    import jax.numpy as jnp
+
+    from predictionio_tpu.ops import ivf
+    from predictionio_tpu.ops.als import top_k_items_batch
+
+    sizes = [
+        int(s)
+        for s in os.environ.get("BENCH_ANN_ITEMS", "27000,65536,262144").split(",")
+        if s.strip()
+    ]
+    chunk = 512
+    n_queries = int(os.environ.get("BENCH_ANN_QUERIES", 8192))
+    n_queries = max(chunk, n_queries // chunk * chunk)
+    nprobe = int(os.environ.get("BENCH_ANN_NPROBE", 8))
+    dim = int(os.environ.get("BENCH_ANN_DIM", 64))
+    k = 128  # one fetch covers recall@10 and recall@100
+    rng = np.random.default_rng(11)
+
+    def clustered(n: int, n_centers: int, seed_centers: np.ndarray) -> np.ndarray:
+        draw = seed_centers[rng.integers(0, n_centers, n)]
+        draw = draw + 0.25 * rng.standard_normal((n, dim)).astype(np.float32)
+        return draw / np.linalg.norm(draw, axis=1, keepdims=True)
+
+    # --- correctness probe: nprobe == nlist must be bit-identical ------
+    n_small = 2048
+    centers = rng.standard_normal((48, dim)).astype(np.float32)
+    items_s = clustered(n_small, 48, centers)
+    q_s = clustered(256, 48, centers)
+    idx_small, _ = ivf.build_ivf(items_s, nlist=16, seed=0, iters=4)
+    uidx_s = np.arange(256, dtype=np.int32)
+    ei, es = top_k_items_batch(uidx_s, jnp.asarray(q_s), jnp.asarray(items_s), 32)
+    ai, a_s = ivf.ivf_topk_users(uidx_s, jnp.asarray(q_s), idx_small, 32, 16)
+    exact_equiv = bool(
+        np.array_equal(np.asarray(ei), np.asarray(ai))
+        and np.array_equal(np.asarray(es), np.asarray(a_s))
+    )
+
+    uidx = np.arange(chunk, dtype=np.int32)
+    sweep = []
+    for n_items in sizes:
+        # ~4 modes per k-means cell keeps cluster sizes balanced, so the
+        # slab width (= the LARGEST cluster, which every probe pays for)
+        # stays near catalog/nlist — the regime a well-tuned deployment
+        # operates in
+        n_centers = 4 * ivf.auto_nlist(n_items)
+        centers = rng.standard_normal((n_centers, dim)).astype(np.float32)
+        items = clustered(n_items, n_centers, centers)
+        queries = clustered(n_queries, n_centers, centers)
+        index, build_info = ivf.build_ivf(items, nlist=0, seed=0, iters=8)
+        items_d = jnp.asarray(items)
+        queries_d = jnp.asarray(queries)
+        kk = min(k, n_items)
+
+        def timed(fn) -> tuple[dict, np.ndarray]:
+            # one warm chunk compiles; timed chunks measure steady state
+            np.asarray(fn(queries_d[:chunk])[0])
+            lat = []
+            ids_out = []
+            t_start = time.perf_counter()
+            for lo in range(0, n_queries, chunk):
+                t0 = time.perf_counter()
+                ids, _scores = fn(queries_d[lo : lo + chunk])
+                ids = np.asarray(ids)  # blocks until the dispatch is done
+                lat.append(time.perf_counter() - t0)
+                ids_out.append(ids)
+            wall = time.perf_counter() - t_start
+            lat_ms = np.asarray(lat) * 1e3
+            return {
+                "queries_per_sec": round(n_queries / wall, 1),
+                "dispatch_p50_ms": round(float(np.percentile(lat_ms, 50)), 3),
+                "dispatch_p99_ms": round(float(np.percentile(lat_ms, 99)), 3),
+            }, np.concatenate(ids_out, axis=0)
+
+        exact_stats, exact_ids = timed(
+            lambda q: top_k_items_batch(uidx, q, items_d, kk)
+        )
+        ann_stats, ann_ids = timed(
+            lambda q: ivf.ivf_topk_users(uidx, q, index, kk, nprobe)
+        )
+
+        def recall_at(n: int) -> float:
+            hits = 0
+            for e_row, a_row in zip(exact_ids[:, :n], ann_ids[:, :n]):
+                hits += len(set(e_row.tolist()) & set(a_row.tolist()))
+            return round(hits / (n * exact_ids.shape[0]), 4)
+
+        probed_frac = min(1.0, nprobe * index.slab_width / n_items)
+        sweep.append(
+            {
+                "catalog_items": n_items,
+                "nlist": index.nlist,
+                "nprobe": nprobe,
+                "slab_width": index.slab_width,
+                "build_seconds": build_info["buildSeconds"],
+                "fraction_of_catalog_scored": round(probed_frac, 4),
+                "exact": exact_stats,
+                "ann": ann_stats,
+                "speedup": round(
+                    ann_stats["queries_per_sec"]
+                    / max(exact_stats["queries_per_sec"], 1e-9),
+                    3,
+                ),
+                "recall_at_10": recall_at(10),
+                "recall_at_100": recall_at(min(100, kk)),
+            }
+        )
+    return {
+        "queries": n_queries,
+        "dim": dim,
+        "k": k,
+        "chunk": chunk,
+        "catalog_axis": sizes,
+        "exact_equiv_nprobe_eq_nlist": exact_equiv,
+        "sweep": sweep,
+    }
+
+
 def _bench_lint() -> dict:
     """Full-tree piolint pass (predictionio_tpu.analysis — AST only, no
     imports of linted modules, no jax init). Reporting the rule and
@@ -1723,6 +1866,14 @@ def main() -> None:
         os.environ["BENCH_CHAOS_EVENTS"] = "40"
         os.environ["BENCH_CHAOS_BACKEND"] = "sqlite"
         os.environ["BENCH_LINT"] = "1"
+        # ann sweep: the largest point must sit past the CPU crossover
+        # (XLA:CPU gather throughput caps ANN around ~500M gathered
+        # elements/s, so exact's linear-in-catalog GEMM only falls
+        # behind by >= 2x north of ~100k items at nprobe 4)
+        os.environ["BENCH_ANN"] = "1"
+        os.environ["BENCH_ANN_ITEMS"] = "16384,262144"
+        os.environ["BENCH_ANN_QUERIES"] = "2048"
+        os.environ["BENCH_ANN_NPROBE"] = "4"
         os.environ.pop("BENCH_PRECISION_COMPARE", None)
         # fresh compile cache: a persistent cache populated on a different
         # host can carry AOT results whose CPU features mismatch (SIGILL risk)
@@ -1829,6 +1980,12 @@ def main() -> None:
             detail["batchpredict"] = _bench_batchpredict(on_accel)
         except Exception as e:
             detail["batchpredict"] = {"error": str(e)[:300]}
+
+    if os.environ.get("BENCH_ANN", "1") != "0":
+        try:
+            detail["ann_retrieval"] = _bench_ann_retrieval()
+        except Exception as e:
+            detail["ann_retrieval"] = {"error": str(e)[:300]}
 
     if os.environ.get("BENCH_RESILIENCE", "1") != "0":
         outage_s = float(os.environ.get("BENCH_RES_OUTAGE_S", 2.0))

@@ -68,6 +68,7 @@ from predictionio_tpu.ops.compat import (
     sharded_scatter_add,
     sharded_scatter_set,
 )
+from predictionio_tpu.ops.topk import top_k_scores
 
 __all__ = [
     "ALSConfig",
@@ -1506,8 +1507,7 @@ def top_k_items(
     scores = item_factors @ user_vec
     if exclude_mask is not None:
         scores = jnp.where(exclude_mask, -jnp.inf, scores)
-    values, indices = jax.lax.top_k(scores, k)
-    return indices, values
+    return top_k_scores(scores, k)
 
 
 @functools.partial(jax.jit, static_argnames=("k",))
@@ -1529,8 +1529,7 @@ def top_k_items_batch(
     per chunk amortizes that latency over the whole chunk."""
     user_vecs = user_factors[user_idx]
     scores = user_vecs @ item_factors.T
-    values, indices = jax.lax.top_k(scores, k)
-    return indices, values
+    return top_k_scores(scores, k)
     # NB: donating the user_idx staging buffer was considered for the
     # pinned serving path and rejected: XLA input-output aliasing needs
     # byte-compatible shapes, and the (chunk,) int32 index buffer can

@@ -1,0 +1,140 @@
+"""Shared top-K selection kernels — one tie-break rule everywhere.
+
+Every ranked surface in the product (recommendation / similarproduct /
+twotower / ecommerce serving, ``pio batchpredict``, the IVF retrieval
+merge) must order candidates identically, or the exact and approximate
+paths diverge on tied scores and host/device results stop being
+comparable. The rule is the one ``jax.lax.top_k`` implements natively:
+
+    **descending score, ties broken by ascending item index.**
+
+Three entry points share it:
+
+* :func:`top_k_scores` — jitted ``lax.top_k`` over naturally-ordered
+  scores (the exact device path; ties -> ascending position is the
+  operator's own guarantee).
+* :func:`top_k_permuted` — jitted tie-stable top-K when the score axis
+  is NOT in item-id order (the IVF cluster-major merge): a two-key
+  ``lax.sort`` on ``(-score, id)`` reproduces the exact rule in the
+  *original id space*, which is what makes ``nprobe == nlist`` IVF
+  bit-identical to exact retrieval including tie order.
+* :func:`top_k_host` — the numpy mirror (argpartition + lexsort) used by
+  the host serving paths, so host and device agree wherever the float
+  scores do.
+
+Before this module each template carried its own argsort-based variant;
+similarproduct/ecommerce used ``argsort(...)[::-1]``, whose reversal
+orders TIES by descending index — silently different from every other
+path. Hoisting the helper is what fixed that.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["top_k_scores", "top_k_permuted", "top_k_host"]
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def top_k_scores(scores: jax.Array, k: int) -> tuple[jax.Array, jax.Array]:
+    """Top-k of ``scores`` along the last axis: ``(indices, values)``.
+    Ties break toward the lower index (``lax.top_k``'s contract)."""
+    values, indices = jax.lax.top_k(scores, k)
+    return indices, values
+
+
+@functools.partial(jax.jit, static_argnames=("k", "big_ids"))
+def top_k_permuted(
+    scores: jax.Array, ids: jax.Array, k: int, big_ids: bool = False
+) -> tuple[jax.Array, jax.Array]:
+    """Tie-stable top-k when position != item id: ``scores[..., n]``
+    belongs to item ``ids[..., n]`` (any permutation/padding of the id
+    space). Returns ``(ids [..., k], scores [..., k])`` ordered by
+    descending score, ties by ascending id — the same ranking
+    :func:`top_k_scores` produces on the naturally-ordered axis, which
+    is what lets the IVF merge reproduce exact top-K bit-identically
+    when every cluster is probed.
+
+    A plain ``lax.top_k`` on the scores would break ties by *candidate
+    position* (cluster-major order, not id order), and a full two-key
+    ``lax.sort`` is O(n log n) per row — measured SLOWER than exact
+    full-catalog scoring on CPU at bench shapes (and any non-trivial
+    selection pass misses XLA:CPU's fast f32 TopK path by ~10-20x). The
+    hot path therefore runs exactly ONE fast f32 ``top_k`` plus an
+    O(k log k) sort, and the expensive exact-tie machinery hides behind
+    a ``lax.cond`` that only executes when ties actually bite:
+
+    1. ``lax.top_k`` over the (f32) scores selects by exact float order
+       — but resolves ties by position.
+    2. Position-ties only pick the wrong CANDIDATE SET when ties at the
+       k-th-value boundary straddle it (ties strictly above select both
+       members either way). A cheap reduce detects that — equality of
+       the tied-at-boundary counts inside and across the whole row —
+       and the repair branch runs ONLY then: a second ``top_k`` over
+       ``-id`` (masked to boundary-tied candidates; ids are exact in
+       f32 below 2^24) yields the tied candidates in ascending-id
+       order, and pass 1's tie slots are reassigned from it.
+    3. The k winners (gathered ids + original scores, bit-exact) are
+       ordered by a two-key sort on ``(-score, id)`` — k elements per
+       row, negligible next to the selection.
+
+    ``big_ids=True`` (required when ids can reach 2^24, where f32
+    spacing exceeds 1) keeps exactness through a full two-key sort —
+    correct for any id, at the O(n log n) cost."""
+    if big_ids:
+        neg, sid = jax.lax.sort((-scores, ids), num_keys=2)
+        return sid[..., :k], -neg[..., :k]
+    t, pos = jax.lax.top_k(scores, k)
+    # the barrier keeps downstream slices/compares out of the top_k's
+    # fusion: XLA:CPU's fast TopK rewrite bails when the sort's results
+    # are consumed by a fused slice, silently falling back to a ~10x
+    # slower generic sort (measured; same story for the repair branch)
+    t, pos = jax.lax.optimization_barrier((t, pos))
+    kth = t[..., -1:]
+
+    def repair(_):
+        is_strict = t > kth
+        tie_key = jnp.where(scores == kth, -ids.astype(scores.dtype), -jnp.inf)
+        tie_pos = jax.lax.optimization_barrier(jax.lax.top_k(tie_key, k))[1]
+        # the j-th non-strict slot takes the j-th smallest-id boundary tie
+        tie_rank = jnp.cumsum((~is_strict).astype(jnp.int32), axis=-1) - 1
+        return jnp.where(
+            is_strict,
+            pos,
+            jnp.take_along_axis(tie_pos, jnp.maximum(tie_rank, 0), axis=-1),
+        )
+
+    boundary_ties_bite = jnp.any(
+        jnp.sum(scores == kth, axis=-1) > jnp.sum(t == kth, axis=-1)
+    )
+    final_pos = jax.lax.cond(boundary_ties_bite, repair, lambda _: pos, None)
+    sel_ids = jnp.take_along_axis(ids, final_pos, axis=-1)
+    sel_scores = jnp.take_along_axis(scores, final_pos, axis=-1)
+    neg, out_ids = jax.lax.sort((-sel_scores, sel_ids), num_keys=2)
+    return out_ids, -neg
+
+
+def top_k_host(scores: np.ndarray, k: int) -> tuple[np.ndarray, np.ndarray]:
+    """Numpy top-k over the last axis of a 1-D or 2-D score array with
+    the shared tie rule; returns ``(indices, values)``. ``argpartition``
+    keeps it O(n + k log k) per row — the host serving path at catalog
+    sizes below ~10^6 items."""
+    k = min(int(k), scores.shape[-1])
+    if k <= 0:
+        shape = scores.shape[:-1] + (0,)
+        return np.zeros(shape, np.int64), np.zeros(shape, scores.dtype)
+    if scores.ndim == 1:
+        part = np.argpartition(scores, -k)[-k:]
+        top = part[np.lexsort((part, -scores[part]))]
+        return top, scores[top]
+    part = np.argpartition(scores, -k, axis=-1)[..., -k:]
+    vals = np.take_along_axis(scores, part, axis=-1)
+    # per-row lexsort: primary key descending value, secondary ascending
+    # original index — np.lexsort's last key is primary
+    order = np.lexsort((part, -vals), axis=-1)
+    top = np.take_along_axis(part, order, axis=-1)
+    return top, np.take_along_axis(scores, top, axis=-1)

@@ -19,6 +19,7 @@ guards that no accelerator dependency creeps in (the layering manifest's
 ``tests/test_ci_guards.py``).
 """
 
+from predictionio_tpu.serving.ann import AnnConfig
 from predictionio_tpu.serving.batcher import (
     AdmissionPolicy,
     BatcherConfig,
@@ -33,6 +34,7 @@ from predictionio_tpu.serving.cache import (
 
 __all__ = [
     "AdmissionPolicy",
+    "AnnConfig",
     "BatcherConfig",
     "CacheConfig",
     "CacheStats",

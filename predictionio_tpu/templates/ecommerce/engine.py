@@ -305,8 +305,9 @@ class ECommAlgorithm(JaxAlgorithm):
         k = min(int(query.num), int(allowed.sum()))
         if k <= 0:
             return PredictedResult(())
-        part = np.argpartition(scores, -k)[-k:]
-        top = part[np.argsort(scores[part])[::-1]]
+        from predictionio_tpu.ops.topk import top_k_host
+
+        top, _ = top_k_host(scores, k)  # shared tie rule (ops/topk.py)
         return PredictedResult(
             tuple(
                 ItemScore(item=model.item_index.inverse(int(i)), score=float(scores[i]))

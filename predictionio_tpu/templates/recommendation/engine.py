@@ -754,6 +754,32 @@ class ALSAlgorithm(JaxAlgorithm):
             model.item_factors = np.asarray(model.item_factors)
             model._pio_pinned = False
 
+    # --------------------------------------------------- ANN retrieval
+    def build_ann_for_serving(self, model: ALSModel, ann) -> tuple[ALSModel, dict]:
+        """``--ann`` retrieval tier (workflow/device_state.py): cluster
+        the item factors into an on-device IVF index once per model
+        generation; predict/batch_predict then score only ``nprobe``
+        cluster slabs per query instead of the whole catalog. Returns
+        the model (with ``model._pio_ann`` attached) and the build info
+        for ``/stats.json``."""
+        from predictionio_tpu.ops import ivf
+
+        index, info = ivf.build_ivf(
+            np.asarray(model.item_factors),
+            nlist=ann.nlist, seed=ann.seed, iters=ann.kmeans_iters,
+        )
+        model._pio_ann = ivf.AnnRuntime(index, ann.nprobe, info)
+        info = dict(info, algorithm=type(self).__name__,
+                    nprobe=model._pio_ann.nprobe)
+        return model, info
+
+    def release_ann_state(self, model: ALSModel) -> None:
+        """Drop a superseded generation's IVF index (same contract as
+        release_pinned_model: a hot-reloading server must not accumulate
+        one index of device memory per swap)."""
+        if getattr(model, "_pio_ann", None) is not None:
+            model._pio_ann = None
+
     def predict(self, model: ALSModel, query: Query) -> PredictedResult:
         uidx = model.user_index.get(query.user)
         if uidx is None:
@@ -761,14 +787,22 @@ class ALSAlgorithm(JaxAlgorithm):
         k = min(int(query.num), len(model.item_index))
         if k <= 0:
             return PredictedResult(())
-        if isinstance(model.item_factors, np.ndarray):
-            # host path: one GEMV + argpartition, microseconds at catalog
-            # sizes below ~10^6 items
+        ann = getattr(model, "_pio_ann", None)
+        if ann is not None:
+            from predictionio_tpu.ops import ivf
+
+            ids, scores = ivf.query_topk(
+                ann, np.asarray(model.user_factors[uidx]), k
+            )
+            pairs = list(zip(ids, scores))
+        elif isinstance(model.item_factors, np.ndarray):
+            # host path: one GEMV + partial sort, microseconds at catalog
+            # sizes below ~10^6 items (shared tie rule: ops/topk.py)
+            from predictionio_tpu.ops.topk import top_k_host
+
             scores = model.item_factors @ np.asarray(model.user_factors[uidx])
-            part = np.argpartition(scores, -k)[-k:]
-            # ties break by ascending item index (the lax.top_k rule)
-            top = part[np.lexsort((part, -scores[part]))]
-            pairs = [(int(i), float(scores[i])) for i in top]
+            top, vals = top_k_host(scores, k)
+            pairs = [(int(i), float(s)) for i, s in zip(top, vals)]
         else:
             idx, scores = top_k_items(model.user_factors[uidx], model.item_factors, k)
             pairs = [(int(i), float(s)) for i, s in zip(np.asarray(idx), np.asarray(scores))]
@@ -818,12 +852,15 @@ class ALSAlgorithm(JaxAlgorithm):
 
     def _topk_staged(self, model: ALSModel, valid: list):
         """Chunked top-k over ``valid = [(slot, uidx, k), ...]`` — see
-        :func:`predictionio_tpu.templates.serving_util.chunked_topk`."""
+        :func:`predictionio_tpu.templates.serving_util.chunked_topk`.
+        With ``--ann`` state attached the chunks route through the IVF
+        kernel (only ``nprobe`` cluster slabs scored per query)."""
         from predictionio_tpu.templates.serving_util import chunked_topk
 
         return chunked_topk(
             model.user_factors, model.item_factors, valid,
             chunk=self.BATCH_PREDICT_CHUNK,
+            ann=getattr(model, "_pio_ann", None),
         )
 
     def batch_predict_json(

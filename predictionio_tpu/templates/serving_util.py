@@ -19,7 +19,8 @@ TOPK_CHUNK = 2048
 
 
 def chunked_topk(
-    user_mat, item_mat, valid: Sequence[tuple], chunk: int = TOPK_CHUNK
+    user_mat, item_mat, valid: Sequence[tuple], chunk: int = TOPK_CHUNK,
+    ann=None,
 ) -> Iterator[tuple[list, list, list]]:
     """Chunked batch top-k over ``valid = [(slot, uidx, k), ...]``;
     yields ``(part, ids, scores)`` with ids/scores as Python lists — the
@@ -33,12 +34,72 @@ def chunked_topk(
     across chunks and ALL results concatenate on device to cross the
     link in ONE transfer (per-chunk transfers pay a full link round trip
     each — measured ~88 ms through a tunneled chip). ``tolist()``
-    converts whole chunks to Python scalars at C speed."""
+    converts whole chunks to Python scalars at C speed.
+
+    ``ann`` (an :class:`predictionio_tpu.ops.ivf.AnnRuntime`) routes the
+    scoring through the two-stage IVF kernel instead of the full-catalog
+    GEMM: only ``nprobe`` cluster slabs are scored per query, so chunk
+    cost scales with ``nprobe * (catalog / nlist)`` instead of the
+    catalog. Queries whose ``k`` includes a filter over-fetch keep their
+    guarantee — the merge returns ``k`` real candidates whenever the
+    probed clusters hold that many (sentinel-padded rows are trimmed
+    here, before any consumer sees them)."""
     if not valid:
         return
     n_items = int(item_mat.shape[0])
     k_max = max(k for _, _, k in valid)
     k_max = min(n_items, max(16, 1 << (k_max - 1).bit_length()))
+    if ann is not None:
+        import jax.numpy as jnp
+
+        from predictionio_tpu.ops import ivf
+
+        user_on_device = not isinstance(user_mat, np.ndarray)
+        ann_staged: list = []
+        for lo in range(0, len(valid), chunk):
+            part = list(valid[lo : lo + chunk])
+            uidx_arr = np.fromiter((u for _, u, _ in part), np.int32, len(part))
+            if user_on_device:
+                padded = np.zeros(chunk, np.int32)
+                padded[: len(part)] = uidx_arr
+                idx_b, score_b = ivf.ivf_topk_users(
+                    padded, user_mat, ann.index, k_max, ann.nprobe
+                )
+            else:
+                # unpinned model: gather the chunk's user rows on host so
+                # each dispatch uploads [chunk, K] — NOT the whole user
+                # table, which would dwarf the nprobe savings per call
+                qv = np.zeros((chunk, user_mat.shape[1]), np.float32)
+                qv[: len(part)] = np.asarray(user_mat)[uidx_arr]
+                idx_b, score_b = ivf.ivf_topk_batch(
+                    jnp.asarray(qv), ann.index, k_max, ann.nprobe
+                )
+            ann.note_queries(len(part))
+            ann_staged.append((part, idx_b, score_b))
+        # same staging discipline as the exact device path below: keep
+        # dispatches async across chunks, cross the link ONCE
+        if len(ann_staged) > 1:
+            idx_all = np.asarray(
+                jnp.concatenate([i for _, i, _ in ann_staged], axis=0)
+            )
+            score_all = np.asarray(
+                jnp.concatenate([s for _, _, s in ann_staged], axis=0)
+            )
+        else:
+            idx_all = np.asarray(ann_staged[0][1])
+            score_all = np.asarray(ann_staged[0][2])
+        off = 0
+        for part, _, _ in ann_staged:
+            ids_l, scores_l = [], []
+            for r in range(len(part)):
+                i_r, s_r = ivf.trim_row(
+                    idx_all[off + r], score_all[off + r], n_items
+                )
+                ids_l.append(i_r)
+                scores_l.append(s_r)
+            yield part, ids_l, scores_l
+            off += chunk
+        return
     on_device = not isinstance(item_mat, np.ndarray)
     staged: list[tuple[list, object, object]] = []
     for lo in range(0, len(valid), chunk):
@@ -53,18 +114,16 @@ def chunked_topk(
                 padded, user_mat, item_mat, k_max
             )
         else:
+            from predictionio_tpu.ops.topk import top_k_host
+
             scores = (
                 np.asarray(user_mat)[uidx_arr] @ np.asarray(item_mat).T
             )  # [B, I]
-            rows = np.arange(len(part))[:, None]
-            sel = np.argpartition(scores, -k_max, axis=1)[:, -k_max:]
-            vals = scores[rows, sel]
             # descending score, ties broken by ascending item index —
             # the same rule lax.top_k uses, so host and device paths
-            # agree wherever the float scores do
-            order = np.lexsort((sel, -vals))
-            idx_b = sel[rows, order]
-            score_b = vals[rows, order]
+            # agree wherever the float scores do (shared helper:
+            # ops/topk.py)
+            idx_b, score_b = top_k_host(scores, k_max)
         staged.append((part, idx_b, score_b))
     if on_device and len(staged) > 1:
         import jax.numpy as jnp

@@ -205,6 +205,28 @@ class ALSAlgorithm(JaxAlgorithm):
             categories=pd.categories,
         )
 
+    # --------------------------------------------------- ANN retrieval
+    def build_ann_for_serving(
+        self, model: SimilarProductModel, ann
+    ) -> tuple[SimilarProductModel, dict]:
+        """``--ann`` retrieval tier: IVF over the L2-normalized item
+        factors (cosine scoring == inner product on unit rows, so the
+        clustered layout is exactly the metric the queries use)."""
+        from predictionio_tpu.ops import ivf
+
+        index, info = ivf.build_ivf(
+            np.asarray(model.item_factors),
+            nlist=ann.nlist, seed=ann.seed, iters=ann.kmeans_iters,
+        )
+        model._pio_ann = ivf.AnnRuntime(index, ann.nprobe, info)
+        info = dict(info, algorithm=type(self).__name__,
+                    nprobe=model._pio_ann.nprobe)
+        return model, info
+
+    def release_ann_state(self, model: SimilarProductModel) -> None:
+        if getattr(model, "_pio_ann", None) is not None:
+            model._pio_ann = None
+
     def predict(self, model: SimilarProductModel, query: Query) -> PredictedResult:
         idxs = [model.item_index.get(i) for i in query.items]
         idxs = [i for i in idxs if i is not None]
@@ -214,14 +236,46 @@ class ALSAlgorithm(JaxAlgorithm):
         norm = np.linalg.norm(target)
         if norm == 0:
             return PredictedResult(())
+        ann = getattr(model, "_pio_ann", None)
+        if ann is not None and not query.white_list and not query.categories:
+            # ANN path. Exclusions (query items + blacklist) are applied
+            # by OVER-FETCHING num + |excluded| candidates before the
+            # final merge: a post-hoc filter on an exact-num fetch
+            # returns fewer than num items whenever the excluded items
+            # are popular (high-scoring) — the latent hole approximate
+            # retrieval amplifies. whiteList/categories queries fall
+            # back to the exact masked path: a whitelisted item may live
+            # in a cluster the probe never visits, so ANN cannot honor
+            # those filters (docs/serving.md).
+            from predictionio_tpu.ops import ivf
+
+            num = int(query.num)
+            if num <= 0:  # exact-path parity: k = min(num, ...) <= 0
+                return PredictedResult(())
+            exclude = set(idxs)
+            for item in query.black_list or ():
+                bidx = model.item_index.get(item)
+                if bidx is not None:
+                    exclude.add(bidx)
+            ids, scores = ivf.query_topk(
+                ann, target / norm, num + len(exclude)
+            )
+            return PredictedResult(
+                tuple(
+                    ItemScore(item=model.item_index.inverse(int(i)), score=float(s))
+                    for i, s in zip(ids, scores)
+                    if i not in exclude
+                )[:num]
+            )
         scores = model.item_factors @ (target / norm)  # cosine vs all items
         allowed = self._allowed_mask(model, query, exclude=set(idxs))
         scores = np.where(allowed, scores, -np.inf)
         k = min(int(query.num), int(allowed.sum()))
         if k <= 0:
             return PredictedResult(())
-        part = np.argpartition(scores, -k)[-k:]
-        top = part[np.argsort(scores[part])[::-1]]
+        from predictionio_tpu.ops.topk import top_k_host
+
+        top, _ = top_k_host(scores, k)  # shared tie rule (ops/topk.py)
         return PredictedResult(
             tuple(
                 ItemScore(item=model.item_index.inverse(int(i)), score=float(scores[i]))

@@ -394,6 +394,31 @@ class TwoTowerAlgorithm(JaxAlgorithm):
             model.item_vecs = np.asarray(model.item_vecs)
             model._pio_pinned = False
 
+    # --------------------------------------------------- ANN retrieval
+    def build_ann_for_serving(
+        self, model: TwoTowerServingModel, ann
+    ) -> tuple[TwoTowerServingModel, dict]:
+        """``--ann`` retrieval tier (workflow/device_state.py): IVF over
+        the L2-normalized item-tower embeddings; serving scores only
+        ``nprobe`` cluster slabs per query. The seen-item filter keeps
+        its over-fetch (num + |seen| candidates fetched BEFORE the
+        merge), so ANN answers still hold ``num`` unseen items whenever
+        the probed clusters do."""
+        from predictionio_tpu.ops import ivf
+
+        index, info = ivf.build_ivf(
+            np.asarray(model.item_vecs),
+            nlist=ann.nlist, seed=ann.seed, iters=ann.kmeans_iters,
+        )
+        model._pio_ann = ivf.AnnRuntime(index, ann.nprobe, info)
+        info = dict(info, algorithm=type(self).__name__,
+                    nprobe=model._pio_ann.nprobe)
+        return model, info
+
+    def release_ann_state(self, model: TwoTowerServingModel) -> None:
+        if getattr(model, "_pio_ann", None) is not None:
+            model._pio_ann = None
+
     def batch_predict(
         self, model: TwoTowerServingModel, queries
     ) -> list[tuple[int, PredictedResult]]:
@@ -425,7 +450,8 @@ class TwoTowerAlgorithm(JaxAlgorithm):
             valid.append((idx, uidx, k))
         inverse = model.item_index.inverse
         for part, idx_l, score_l in chunked_topk(
-            model.user_vecs, model.item_vecs, valid
+            model.user_vecs, model.item_vecs, valid,
+            ann=getattr(model, "_pio_ann", None),
         ):
             for (oi, _, k), ids, scs in zip(part, idx_l, score_l):
                 seen = seen_by_slot[oi]
@@ -446,15 +472,28 @@ class TwoTowerAlgorithm(JaxAlgorithm):
         if uidx is None or int(query.num) <= 0:
             return PredictedResult(())
         seen = model.seen.get(query.user, ())
+        # over-fetch num + |seen| BEFORE the top-K so the post-hoc seen
+        # filter still leaves num items (applies to the exact and ANN
+        # paths alike)
         k = min(int(query.num) + len(seen), len(model.item_index))
         if k <= 0:
             return PredictedResult(())
-        if isinstance(model.item_vecs, np.ndarray):
+        ann = getattr(model, "_pio_ann", None)
+        if ann is not None:
+            from predictionio_tpu.ops import ivf
+
+            ids, sc = ivf.query_topk(
+                ann, np.asarray(model.user_vecs[uidx]), k
+            )
+            pairs = list(zip(ids, sc))
+        elif isinstance(model.item_vecs, np.ndarray):
+            from predictionio_tpu.ops.topk import top_k_host
+
             scores = model.item_vecs @ np.asarray(model.user_vecs[uidx])
-            part = np.argpartition(scores, -k)[-k:]
-            # ties break by ascending item index (the lax.top_k rule)
-            top = part[np.lexsort((part, -scores[part]))]
-            pairs = [(int(i), float(scores[i])) for i in top]
+            # shared tie rule — descending score, ascending item index
+            # (ops/topk.py), so host and device paths agree
+            top, vals = top_k_host(scores, k)
+            pairs = [(int(i), float(s)) for i, s in zip(top, vals)]
         else:
             from predictionio_tpu.ops.als import top_k_items
 

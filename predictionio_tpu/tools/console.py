@@ -25,6 +25,23 @@ from predictionio_tpu.version import __version__
 __all__ = ["main", "build_parser"]
 
 
+def _int_at_least(floor: int):
+    """argparse ``type=`` validator: int with a lower bound, so a bad
+    value fails at parse time with the usual clean ``usage:`` error
+    instead of a config-construction traceback."""
+
+    def parse(text: str) -> int:
+        try:
+            value = int(text)
+        except ValueError:
+            raise argparse.ArgumentTypeError(f"invalid int value: {text!r}")
+        if value < floor:
+            raise argparse.ArgumentTypeError(f"must be >= {floor}")
+        return value
+
+    return parse
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="pio", description="predictionio_tpu — TPU-native ML server"
@@ -217,6 +234,34 @@ def build_parser() -> argparse.ArgumentParser:
         help="pin factor matrices and the jitted score+top-K programs "
         "device-resident across requests (no per-request staging or "
         "re-trace; bytes pinned reported on /stats.json)",
+    )
+    # ---- approximate retrieval (predictionio_tpu.ops.ivf; docs/serving.md).
+    # Strictly opt-in: without --ann every query scores the exact path.
+    deploy.add_argument(
+        "--ann", action="store_true",
+        help="serve top-K through an on-device IVF (clustered) index "
+        "built at (re)load time: score nprobe cluster slabs per query "
+        "instead of the whole catalog (recall/latency trade-off in "
+        "docs/performance.md; /stats.json grows an 'ann' section)",
+    )
+    deploy.add_argument(
+        "--ann-nlist", type=_int_at_least(0), default=0, metavar="N",
+        help="k-means cluster count for --ann (default 0 = auto, "
+        "~sqrt(catalog items))",
+    )
+    deploy.add_argument(
+        "--ann-nprobe", type=_int_at_least(1), default=8, metavar="N",
+        help="clusters scored per query for --ann (default 8); "
+        "nprobe >= nlist reproduces exact top-K bit-identically",
+    )
+    deploy.add_argument(
+        "--ann-seed", type=int, default=0,
+        help="k-means seed for --ann (index build is deterministic per "
+        "(factors, seed))",
+    )
+    deploy.add_argument(
+        "--ann-kmeans-iters", type=_int_at_least(0), default=8, metavar="N",
+        help="Lloyd iterations after k-means++ seeding (default 8)",
     )
     # ---- resilience (predictionio_tpu.resilience; docs/operations.md).
     # Defaults are the do-nothing configuration: single-attempt storage
@@ -675,9 +720,20 @@ def main(argv: list[str] | None = None) -> int:
                         else args.cache_scope_field
                     ),
                 )
+            ann = None
+            if args.ann:
+                from predictionio_tpu.serving import AnnConfig
+
+                ann = AnnConfig(
+                    enabled=True,
+                    nlist=args.ann_nlist,
+                    nprobe=args.ann_nprobe,
+                    seed=args.ann_seed,
+                    kmeans_iters=args.ann_kmeans_iters,
+                )
             service = QueryService(
                 variant, feedback=feedback, instance_id=args.engine_instance_id,
-                batching=batching, cache=cache,
+                batching=batching, cache=cache, ann=ann,
             )
 
             def wire_stop(server):

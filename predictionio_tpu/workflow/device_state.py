@@ -23,6 +23,15 @@ lives in ``workflow/`` — NOT ``serving/`` — because the serving package
 must stay importable without jax (tier-1 CI guards it); jax itself is
 imported lazily inside the functions so merely importing the workflow
 keeps paying nothing.
+
+The ``--ann`` retrieval tier rides the same boundary and the same
+generation lifecycle: :func:`build_ann_pairs` asks each algorithm that
+implements ``build_ann_for_serving(model, ann_config) -> (model,
+info)`` to cluster its item factors into an on-device IVF index
+(:mod:`predictionio_tpu.ops.ivf`) once per model generation, and
+:func:`release_pairs` drops both the pinned factors AND the superseded
+index when ``/reload`` swaps generations — ANN state hot-swaps exactly
+like pinned factors.
 """
 
 from __future__ import annotations
@@ -30,7 +39,7 @@ from __future__ import annotations
 import logging
 from typing import Sequence
 
-__all__ = ["pin_pairs", "release_pairs"]
+__all__ = ["pin_pairs", "release_pairs", "build_ann_pairs"]
 
 logger = logging.getLogger(__name__)
 
@@ -68,17 +77,59 @@ def pin_pairs(pairs: Sequence) -> tuple[list, int]:
     return out, total
 
 
-def release_pairs(pairs: Sequence) -> None:
-    """Drop pinned device state of a superseded model generation so its
-    buffers become collectable immediately (a hot-reloading server must
-    not accumulate one catalog of HBM per reload)."""
+def build_ann_pairs(pairs: Sequence, ann_config) -> tuple[list, list]:
+    """Build IVF retrieval state for every (algorithm, model) pair whose
+    algorithm supports it (``build_ann_for_serving``).
+
+    Returns ``(pairs, infos)`` — the possibly-updated pair list and one
+    build-info dict per built index (the ``/stats.json`` ``ann``
+    section). Best-effort like pinning: a pair whose build raises is
+    served exact rather than failing the load, and a jax-less host
+    serves everything exact with a warning."""
+    try:
+        import jax  # noqa: F401  (availability probe only)
+    except Exception:  # pragma: no cover - jax is a hard dep in practice
+        logger.warning("--ann requested but jax is unavailable; "
+                       "serving exact retrieval")
+        return list(pairs), []
+    out = []
+    infos = []
     for algo, model in pairs:
-        release = getattr(algo, "release_pinned_model", None)
-        if release is None:
+        build = getattr(algo, "build_ann_for_serving", None)
+        if build is None:
+            out.append((algo, model))
             continue
         try:
-            release(model)
+            model, info = build(model, ann_config)
+            infos.append(info)
+            logger.info(
+                "Built IVF retrieval index for %s: nlist=%s nprobe=%s "
+                "slabWidth=%s build=%ss",
+                type(algo).__name__, info.get("nlist"), info.get("nprobe"),
+                info.get("slabWidth"), info.get("buildSeconds"),
+            )
         except Exception:
             logger.exception(
-                "release_pinned_model failed for %s", type(algo).__name__
+                "build_ann_for_serving failed for %s; serving exact",
+                type(algo).__name__,
             )
+        out.append((algo, model))
+    return out, infos
+
+
+def release_pairs(pairs: Sequence) -> None:
+    """Drop pinned device state AND ANN retrieval state of a superseded
+    model generation so its buffers become collectable immediately (a
+    hot-reloading server must not accumulate one catalog of HBM — or
+    one IVF index — per reload)."""
+    for algo, model in pairs:
+        for name in ("release_pinned_model", "release_ann_state"):
+            release = getattr(algo, name, None)
+            if release is None:
+                continue
+            try:
+                release(model)
+            except Exception:
+                logger.exception(
+                    "%s failed for %s", name, type(algo).__name__
+                )

@@ -27,7 +27,12 @@ from predictionio_tpu.controller.context import WorkflowContext, local_context
 from predictionio_tpu.controller.engine import Engine
 from predictionio_tpu.controller.params import params_from_json, params_to_json
 from predictionio_tpu.data.storage import Storage
-from predictionio_tpu.serving import BatcherConfig, CacheConfig, MicroBatcher
+from predictionio_tpu.serving import (
+    AnnConfig,
+    BatcherConfig,
+    CacheConfig,
+    MicroBatcher,
+)
 from predictionio_tpu.serving.cache import (
     CacheStats,
     ResultCache,
@@ -124,6 +129,7 @@ class QueryService:
         instance_id: str | None = None,
         batching: BatcherConfig | None = None,
         cache: CacheConfig | None = None,
+        ann: AnnConfig | None = None,
     ):
         self.variant = variant
         self.ctx = ctx or local_context()
@@ -131,6 +137,20 @@ class QueryService:
         self.feedback = feedback
         self._requested_instance_id = instance_id
         self._lock = threading.Lock()
+        # approximate retrieval (pio deploy --ann; docs/serving.md).
+        # Strictly opt-in: ann=None (or a disabled config) leaves every
+        # query on the exact scoring path and never imports ops/ivf.
+        # Set BEFORE reload() so the index builds with the first load.
+        self.ann_config = ann if ann is not None and ann.enabled else None
+        #: retrieval-mode tag mixed into cache/singleflight keys so
+        #: exact and ANN results can never serve each other
+        self._cache_mode = (
+            self.ann_config.cache_mode if self.ann_config is not None
+            else "exact"
+        )
+        #: AnnRuntime per ANN-built model of the LIVE generation
+        #: (swapped with the pairs under the lock on every reload)
+        self._ann_runtimes: list = []
         # query-path caching & coalescing (predictionio_tpu.serving.cache;
         # docs/performance.md). Strictly opt-in: cache=None (or an all-off
         # config) leaves /queries.json on the exact prior code path. Built
@@ -310,6 +330,15 @@ class QueryService:
 
                 pairs, bytes_pinned = device_state.pin_pairs(pairs)
                 self._cache_stats.set_gauge("bytes_pinned", bytes_pinned)
+            if self.ann_config is not None:
+                # clustered-retrieval tier: IVF index built once per
+                # model generation behind the same lazy jax boundary;
+                # hot-swaps with the pairs on /reload (docs/serving.md)
+                from predictionio_tpu.workflow import device_state
+
+                pairs, _ann_infos = device_state.build_ann_pairs(
+                    pairs, self.ann_config
+                )
         except Exception as e:
             with self._lock:
                 has_last_good = self._serving is not None
@@ -340,6 +369,11 @@ class QueryService:
             self._engine = engine
             self._serving = serving
             self._algo_model_pairs = pairs
+            self._ann_runtimes = [
+                rt
+                for _, model in pairs
+                if (rt := getattr(model, "_pio_ann", None)) is not None
+            ]
             self.instance = instance
             self.degraded = False
             self.last_reload_error = None
@@ -356,14 +390,17 @@ class QueryService:
         if (
             old_pairs
             and old_pairs is not pairs
-            and self.cache_config is not None
-            and self.cache_config.pin_model
+            and (
+                (self.cache_config is not None and self.cache_config.pin_model)
+                or self.ann_config is not None
+            )
         ):
-            # free the superseded generation's device buffers promptly.
-            # Functionally safe against in-flight queries that snapshotted
-            # the old pairs: release converts the factor views to host
-            # arrays in place, so a racing query computes on host once
-            # rather than reading freed memory
+            # free the superseded generation's device buffers — pinned
+            # factors AND the old IVF index — promptly. Functionally safe
+            # against in-flight queries that snapshotted the old pairs:
+            # release converts the factor views to host arrays (and the
+            # ANN state to None) in place, so a racing query computes
+            # exact on host once rather than reading freed memory
             from predictionio_tpu.workflow import device_state
 
             device_state.release_pairs(old_pairs)
@@ -450,6 +487,11 @@ class QueryService:
         if key is None:
             self._cache_stats.incr("uncacheable")
             return self._scored_query(body)
+        # retrieval mode is part of the key: an ANN answer is a
+        # different (approximate) result for the same body, so exact and
+        # ANN entries must never serve each other — not across a config
+        # change, and not between deployments sharing a warmed cache
+        key = f"{self._cache_mode}|{key}"
         cfg = self.cache_config
         rc = self._result_cache
         scope = extract_scope(body, cfg.scope_field)
@@ -662,6 +704,7 @@ class QueryService:
             "feedbackDropped": self.feedback_dropped,
             "batching": self.batcher is not None,
             "caching": self.cache_config is not None,
+            "ann": self.ann_config is not None,
             # degraded-mode semantics (docs/operations.md): serving the
             # last-good model after a failed reload
             "degraded": self.degraded,
@@ -705,6 +748,21 @@ class QueryService:
             # hit/miss/coalesced counters, eviction + invalidation
             # breakdown, bytes pinned (docs/performance.md)
             out["cache"] = self._cache_stats.to_json()
+        if self.ann_config is not None:
+            # approximate-retrieval decomposition (docs/serving.md):
+            # effective nlist/nprobe plus, per built index, clusters
+            # scored and the fraction of the catalog each query paid for
+            with self._lock:
+                runtimes = list(self._ann_runtimes)
+            out["ann"] = {
+                # nlist 0 means auto (~sqrt(catalog)) — report what the
+                # build actually picked, not the sentinel
+                "nlist": self.ann_config.nlist
+                or (runtimes[0].index.nlist if runtimes else 0),
+                "nprobe": self.ann_config.nprobe,
+                "cacheMode": self._cache_mode,
+                "models": [rt.stats_json() for rt in runtimes],
+            }
         return out
 
     def readiness(self) -> dict:

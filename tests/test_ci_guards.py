@@ -273,6 +273,40 @@ def test_serving_cache_module_is_stdlib_only():
     assert proc.returncode == 0, proc.stderr.decode()[-500:]
 
 
+def test_ann_defaults_are_opt_in():
+    """ISSUE 6 guard: approximate retrieval is strictly opt-in. Without
+    ``--ann`` the deploy parser yields no AnnConfig, QueryService takes
+    the exact scoring path with an ``exact``-tagged cache namespace, and
+    ``ops/ivf`` is never even imported (the exact path must be
+    byte-identical to a build without the module — the import probe
+    lives in tests/test_ivf.py). The serving-side config module itself
+    must satisfy the jax-free serving manifest like every other file in
+    the package."""
+    import inspect
+
+    from predictionio_tpu.serving import AnnConfig
+    from predictionio_tpu.tools.console import build_parser
+    from predictionio_tpu.workflow.serving import QueryService
+
+    args = build_parser().parse_args(["deploy"])
+    assert args.ann is False
+    assert args.ann_nlist == 0  # auto ~sqrt(catalog)
+    assert args.ann_nprobe == 8
+    sig = inspect.signature(QueryService.__init__)
+    assert sig.parameters["ann"].default is None
+    cfg = AnnConfig()
+    assert cfg.enabled is False
+    assert cfg.cache_mode == "exact"
+    # exact and ANN cache entries live in disjoint key namespaces
+    assert AnnConfig(enabled=True, nlist=4, nprobe=2).cache_mode != cfg.cache_mode
+    # ANN state hot-swaps through the same device_state lifecycle as
+    # pinned factors: the release path must drop BOTH
+    from predictionio_tpu.workflow import device_state
+
+    src = inspect.getsource(device_state.release_pairs)
+    assert "release_ann_state" in src and "release_pinned_model" in src
+
+
 def test_bench_smoke_runs_green():
     """Execute the real bench in --smoke mode (tiny shapes, CPU, <60 s
     budget) and validate its one-line JSON contract."""
@@ -285,7 +319,7 @@ def test_bench_smoke_runs_green():
         cwd=REPO,
         capture_output=True,
         text=True,
-        timeout=180,
+        timeout=300,  # the ann_retrieval sweep adds ~30 s of kmeans+scan
         env=env,
     )
     assert proc.returncode == 0, (
@@ -381,6 +415,29 @@ def test_bench_smoke_runs_green():
     assert chaos["drain"]["exitCode"] == 0
     assert chaos["drain"]["raw500s"] == 0
     assert chaos["drain"]["withinDeadline"] is True
+    # approximate-retrieval section (ISSUE 6 acceptance): the catalog
+    # sweep must show measured recall@10 >= 0.95 at every smoke point,
+    # >= 2x q/s over exact at the largest point, and the nprobe==nlist
+    # mode must reproduce exact top-K bit-identically
+    ann = detail.get("ann_retrieval")
+    assert ann is not None, "missing bench section 'ann_retrieval'"
+    assert "error" not in ann, f"ann_retrieval errored: {ann}"
+    assert ann["exact_equiv_nprobe_eq_nlist"] is True
+    assert len(ann["sweep"]) >= 2
+    for point in ann["sweep"]:
+        assert point["recall_at_10"] >= 0.95, point
+        assert point["exact"]["queries_per_sec"] > 0
+        assert point["ann"]["queries_per_sec"] > 0
+        assert 0 < point["fraction_of_catalog_scored"] < 1
+    largest = max(ann["sweep"], key=lambda p: p["catalog_items"])
+    assert largest["speedup"] >= 2.0, (
+        f"ANN shows no >=2x win at the largest sweep point: {largest}"
+    )
+    # catalog size is an explicit axis on the serving/batchpredict
+    # sections so BENCH_r06+ can plot q/s-vs-items across rounds
+    assert detail["batchpredict"]["catalog_items"] > 0
+    assert detail["serving_latency"]["catalog_items"] > 0
+    assert conc["catalog_items"] > 0 and conc["catalog_users"] > 0
     # static-analysis section (ISSUE 3): the bench reports piolint rule
     # and finding counts so the guard output stays machine-checked — a
     # tree with non-baselined findings cannot produce a green smoke
