@@ -101,6 +101,19 @@ DEFAULT_MANIFEST: Manifest = (
         "on top of it",
     ),
     PackageRule(
+        package="predictionio_tpu/online",
+        forbid=(
+            "predictionio_tpu.templates",
+            "predictionio_tpu.tools",
+            "predictionio_tpu.api",
+        ),
+        reason="online fold-in sits on ops+data+workflow(+serving) and "
+        "reaches algorithms only through duck-typed hooks — importing a "
+        "template would couple the subsystem to one engine (templates "
+        "import online.types, never the reverse); its background threads "
+        "must declare daemon= explicitly (PIO204 covers the whole tree)",
+    ),
+    PackageRule(
         package="predictionio_tpu/templates",
         sibling_isolation=True,
         allow=("serving_util", "columnar_util", "results"),

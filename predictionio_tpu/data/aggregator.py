@@ -129,6 +129,20 @@ class BiMap:
     def to_dict(self) -> dict[str, int]:
         return dict(self._forward)
 
+    def extended(self, new_keys: Iterable[str]) -> "BiMap":
+        """A NEW BiMap with ``new_keys`` appended at the next dense
+        indices (already-present keys are ignored). BiMaps stay
+        immutable — the online fold-in swaps the extended map in with
+        one atomic attribute assignment, so concurrent readers see
+        either the old or the new mapping, never a half-built one."""
+        forward = dict(self._forward)
+        for k in new_keys:
+            if k not in forward:
+                forward[k] = len(forward)
+        if len(forward) == len(self._forward):
+            return self
+        return BiMap(forward)
+
     @classmethod
     def from_dict(cls, d: Mapping[str, int]) -> "BiMap":
         return cls(d)

@@ -602,8 +602,22 @@ class _ColumnarEvents(LEvents):
             # an unreplayed compaction marker would truncate the tail on
             # the next read — finish it BEFORE appending new lines
             self._recover(d)
-            with open(os.path.join(d, "tail.jsonl"), "a") as f:
-                f.write("".join(line + "\n" for line in lines))
+            path = os.path.join(d, "tail.jsonl")
+            prefix = ""
+            try:
+                with open(path, "rb") as rf:
+                    rf.seek(-1, os.SEEK_END)
+                    if rf.read(1) != b"\n":
+                        # a writer (possibly another process) died
+                        # mid-append, leaving torn bytes with no
+                        # newline: isolate them on their own line so
+                        # THIS acked event is not merged into one
+                        # undecodable hybrid and lost
+                        prefix = "\n"
+            except (FileNotFoundError, OSError):
+                pass  # no tail yet (or empty): nothing to isolate
+            with open(path, "a") as f:
+                f.write(prefix + "".join(line + "\n" for line in lines))
                 if self._fsync:
                     f.flush()
                     os.fsync(f.fileno())
@@ -699,6 +713,170 @@ class _ColumnarEvents(LEvents):
             if fresh:
                 self.insert_batch(fresh, app_id, channel_id)
         return out  # type: ignore[return-value]
+
+    # ------------------------------------------------------- tail following
+    #: consumed tail event ids remembered in a follow cursor. After a
+    #: compaction moves consumed tail lines into an explicit-id segment,
+    #: the newest chain id found in the new segments re-anchors the
+    #: consumed prefix — so a follower never re-reads what it already
+    #: consumed, even across a process restart straddling the compaction.
+    _FOLLOW_CHAIN = 64
+
+    def tail_follow(
+        self,
+        app_id: int,
+        channel_id: int | None = None,
+        cursor: dict | None = None,
+        from_start: bool = False,
+    ) -> tuple[list[Event], dict]:
+        """Exactly-once delta read for the online-learning follower
+        (:mod:`predictionio_tpu.online.follower`): return every event
+        appended since ``cursor`` and the advanced cursor.
+
+        The cursor records ``(stream_id, compactions, consumed segment
+        names, consumed tail line count, recent tail ids)``. Three store
+        mutations are survived without loss or duplication:
+
+        * **segment roll** — bulk writes land whole new (positional-id)
+          segments; any segment name not in the cursor is new and read in
+          full;
+        * **compaction** — the consumed tail prefix moves into new
+          explicit-id segments. The newest ``recent_ids`` chain entry
+          found in those segments marks the end of the consumed prefix;
+          rows at or before it are skipped, everything after (and the
+          reset tail) is new. A chain entry only misses if every one of
+          the last ``_FOLLOW_CHAIN`` consumed events was individually
+          deleted before the compaction — the documented (rare) window
+          where re-delivery is possible; events are never skipped;
+        * **stream drop/recreate** — the ``stream_id`` mismatch resets
+          the cursor instead of mis-counting the new tail as consumed.
+
+        A fresh (or reset) cursor starts at the END of the stream unless
+        ``from_start`` — online serving folds new events, not history.
+        Tombstoned events are filtered like every other scan. The caller
+        owns cursor persistence (see ``TailFollower.commit``)."""
+        d = self._ensure_stream(app_id, channel_id)
+        with self._lock:
+            self._recover(d)
+            seg_paths = self._segment_paths(d)
+            try:
+                with open(os.path.join(d, "tail.jsonl")) as f:
+                    lines = [ln for ln in f if ln.strip()]
+            except FileNotFoundError:
+                lines = []
+            tomb = self._tombstones(d)
+            compactions = self._compactions(d)
+            stream_id = self._stream_id(d)
+        tail_tomb, seg_tomb = self._split_tombstones(tomb)
+        names = [os.path.splitext(os.path.basename(p))[0] for p in seg_paths]
+
+        tail_objs: list[dict] = []
+        for ln in lines:
+            try:
+                tail_objs.append(json.loads(ln))
+            except json.JSONDecodeError:
+                # torn (crash-mid-append) bytes: never acked, never
+                # followed — and never COUNTED. The cursor indexes
+                # DECODABLE lines only, so the recovery sweep's trim
+                # (which rewrites the tail without the torn bytes)
+                # cannot shift consumed indices under a live watermark
+                # and skip the next appended event.
+                continue
+
+        fresh = (
+            cursor is None
+            or not cursor.get("stream_id")
+            or cursor.get("stream_id") != stream_id
+        )
+        if fresh and not from_start:
+            chain = [
+                i
+                for i in (str(o.get("eventId") or "") for o in tail_objs)
+                if i
+            ]
+            return [], {
+                "stream_id": stream_id,
+                "compactions": compactions,
+                "segments": names,
+                "tail_lines": len(tail_objs),
+                "recent_ids": chain[-self._FOLLOW_CHAIN:],
+            }
+        if fresh:
+            cursor = {
+                "stream_id": stream_id,
+                "compactions": compactions,
+                "segments": [],
+                "tail_lines": 0,
+                "recent_ids": [],
+            }
+        assert cursor is not None
+        known = set(cursor.get("segments", ()))
+        chain = [str(i) for i in cursor.get("recent_ids", ())]
+        same_gen = int(cursor.get("compactions", 0)) == compactions
+        new_paths = [p for p, n in zip(seg_paths, names) if n not in known]
+        events: list[Event] = []
+
+        if same_gen:
+            seg_plan = [(p, 0) for p in new_paths]
+            tail_start = min(int(cursor.get("tail_lines", 0)), len(tail_objs))
+        else:
+            # compaction(s) landed: locate the consumed prefix inside the
+            # new explicit-id segments via the newest chain id present
+            loaded = {p: self._segment(p) for p in new_paths}
+            cut: tuple[int, int] | None = None
+            for si, p in enumerate(new_paths):
+                seg = loaded[p]
+                if seg.ids is None:
+                    continue
+                for cid in reversed(chain):  # newest consumed first
+                    hits = np.flatnonzero(seg.ids == cid)
+                    if hits.size:
+                        cand = (si, int(hits[0]))
+                        if cut is None or cand > cut:
+                            cut = cand
+                        break
+            seg_plan = []
+            for si, p in enumerate(new_paths):
+                seg = loaded[p]
+                if cut is not None and seg.ids is not None:
+                    if si < cut[0]:
+                        continue  # fully inside the consumed prefix
+                    if si == cut[0]:
+                        seg_plan.append((p, cut[1] + 1))
+                        continue
+                seg_plan.append((p, 0))
+            tail_start = 0  # the whole current tail postdates the compaction
+
+        for p, start_row in seg_plan:
+            seg = self._segment(p)
+            if seg.ids is not None:
+                for row in range(start_row, len(seg)):
+                    if str(seg.ids[row]) not in tail_tomb:
+                        events.append(seg.row_event(row))
+            else:
+                dead = seg_tomb.get(seg.name, ())
+                for row in range(start_row, len(seg)):
+                    if row not in dead:
+                        events.append(seg.row_event(row))
+
+        new_tail_ids: list[str] = []
+        for obj in tail_objs[tail_start:]:
+            e = self._decode_tail(obj)
+            if e.event_id:
+                new_tail_ids.append(e.event_id)
+            if e.event_id not in tail_tomb:
+                events.append(e)
+        if same_gen:
+            chain = (chain + new_tail_ids)[-self._FOLLOW_CHAIN:]
+        else:
+            chain = new_tail_ids[-self._FOLLOW_CHAIN:]
+        return events, {
+            "stream_id": stream_id,
+            "compactions": compactions,
+            "segments": names,
+            "tail_lines": len(tail_objs),
+            "recent_ids": chain,
+        }
 
     def compact(self, app_id: int, channel_id: int | None = None) -> int:
         """Seal the live JSONL tail into explicit-id segments and drop
@@ -1380,6 +1558,15 @@ class _ColumnarPEvents(PEvents):
 
     def scan_state(self, app_id: int, channel_id: int | None = None) -> dict:
         return self._e.scan_state(app_id, channel_id)
+
+    def tail_follow(
+        self,
+        app_id: int,
+        channel_id: int | None = None,
+        cursor: dict | None = None,
+        from_start: bool = False,
+    ) -> tuple[list[Event], dict]:
+        return self._e.tail_follow(app_id, channel_id, cursor, from_start)
 
 
 class StorageClient(BaseStorageClient):

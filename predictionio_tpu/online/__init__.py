@@ -1,0 +1,36 @@
+"""Online learning — incremental fold-in from the event tail to the
+live serving model, in seconds instead of retrains (ROADMAP item 3).
+
+The closed loop the PredictionIO blueprint promises — events in, fresh
+predictions out — used to close only through a full ``pio train``. This
+package closes it online:
+
+* :mod:`~predictionio_tpu.online.follower` — a durable tail follower
+  with a persisted watermark cursor over the columnar event store
+  (survives segment roll, compaction, and process restart exactly-once);
+* :mod:`~predictionio_tpu.online.foldin` — a jitted batched ALS re-solve
+  of ONLY the touched user/item rows against fixed opposite-side factors
+  (the classic MLlib-era fold-in), plus cold-start row injection for
+  never-seen entities;
+* :mod:`~predictionio_tpu.online.trainer` — a streaming mini-batch
+  trainer for two-tower embeddings consuming the same delta stream in a
+  background daemon thread;
+* :mod:`~predictionio_tpu.online.runner` — the orchestration daemon:
+  poll the follower, group deltas, dispatch to each deployed algorithm's
+  online hooks, hot-swap the touched rows through
+  ``QueryService.apply_online_update`` (per-scope cache invalidation,
+  device re-pin of delta rows, incremental IVF index update), commit the
+  watermark.
+
+Layering (piolint manifest): this package may import ``ops``, ``data``,
+``workflow`` and ``serving`` — never templates or tools; algorithms
+participate through duck-typed hooks (see
+:mod:`~predictionio_tpu.online.types`). Strictly opt-in behind ``pio
+deploy --online``: with the flag off nothing here is imported at all
+(CI-guarded), and this ``__init__`` plus ``types`` stay jax-free so
+merely constructing an :class:`OnlineConfig` costs nothing.
+"""
+
+from predictionio_tpu.online.types import EventDelta, OnlineConfig, OnlineUpdate
+
+__all__ = ["EventDelta", "OnlineConfig", "OnlineUpdate"]

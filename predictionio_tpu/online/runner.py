@@ -1,0 +1,330 @@
+"""Online-learning orchestration — the ``pio deploy --online`` daemon.
+
+One background thread closes the loop the paper's blueprint promises:
+
+    event store tail --> per-entity deltas --> fold-in / streaming SGD
+        --> QueryService.apply_online_update (touched rows only)
+        --> watermark commit
+
+Per poll: the durable :class:`~predictionio_tpu.online.follower
+.TailFollower` returns everything appended since the watermark; deltas
+are dispatched to each deployed algorithm's online hooks (fold-in for
+matrix-factorization models, the :class:`~predictionio_tpu.online
+.trainer.StreamingTrainer` for towers); the computed rows hot-swap into
+serving under the generation lock with per-scope cache invalidation and
+incremental IVF index maintenance; only then does the watermark commit
+— a crash re-delivers (and re-solves, idempotently) rather than skips.
+
+A full ``/reload`` supersedes everything here: the runner detects the
+generation bump, rebinds to the fresh pairs, and drops in-flight
+updates computed against the old generation (``apply_online_update``
+validates the generation token under the lock).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+
+from predictionio_tpu.online.follower import TailFollower, to_deltas
+from predictionio_tpu.online.types import OnlineConfig
+
+__all__ = ["OnlineRunner"]
+
+logger = logging.getLogger(__name__)
+
+#: fold-latency / freshness sample ring size for /stats.json percentiles
+_SAMPLES = 256
+
+
+def _percentile(samples: list[float], q: float) -> float | None:
+    if not samples:
+        return None
+    s = sorted(samples)
+    return s[min(len(s) - 1, int(q * len(s)))]
+
+
+class OnlineRunner:
+    """Owns the follower thread and the per-pair online adapters for one
+    deployed :class:`~predictionio_tpu.workflow.serving.QueryService`."""
+
+    def __init__(self, service, config: OnlineConfig):
+        self.service = service
+        self.config = config
+        ds_params: dict = {}
+        inst = service.instance
+        if inst is not None and getattr(inst, "datasource_params", None):
+            try:
+                ds_params = json.loads(inst.datasource_params) or {}
+            except ValueError:
+                ds_params = {}
+        if not ds_params:
+            # fall back to the variant's raw engine.json
+            ds_params = (
+                (service.variant.raw.get("datasource") or {}).get("params")
+                or {}
+            )
+        self.ds_params = ds_params
+        app_name = ds_params.get("appName") or ds_params.get("app_name") or ""
+        if not app_name:
+            raise ValueError(
+                "--online requires the engine's datasource params to name "
+                "an appName (the stream to follow)"
+            )
+        self.follower = TailFollower(
+            app_name,
+            channel=ds_params.get("channelName"),
+            state_dir=config.state_dir,
+            from_start=config.from_start,
+        )
+        self._lock = threading.Lock()
+        #: serializes whole fold cycles: the daemon cadence and a manual
+        #: POST /online/fold.json must not interleave poll/apply/commit
+        self._cycle_lock = threading.Lock()
+        self.folds = 0
+        self.events_seen = 0
+        self.events_folded = 0
+        self.last_error: str | None = None
+        self._fold_ms: list[float] = []
+        self._visible_s: list[float] = []
+        self._bound_generation = -1
+        self._trainers: dict[int, object] = {}
+        self._stop = threading.Event()
+        self._wake = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="pio-online-follower"
+        )
+        self._thread.start()
+
+    # -------------------------------------------------------------- control
+    def stop(self) -> None:
+        self._stop.set()
+        self._wake.set()
+        self._thread.join(timeout=5.0)
+        with self._lock:
+            trainers = list(self._trainers.values())
+            self._trainers = {}
+        for t in trainers:
+            t.stop()
+
+    def fold_now(self, timeout_s: float = 30.0) -> dict:
+        """Synchronous poll+fold — the ``POST /online/fold.json`` manual
+        trigger (and the test hook). Runs one cycle on the caller's
+        thread; the daemon keeps its own cadence. A deadline abort
+        rolls the watermark back (``requeued: true`` in the response) —
+        nothing is lost, the daemon (which runs without a deadline)
+        drains the backlog on its next cycle."""
+        return self._cycle(deadline=time.monotonic() + timeout_s)
+
+    # ----------------------------------------------------------------- loop
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._cycle()
+            except Exception as e:  # keep following; surface on /stats.json
+                with self._lock:
+                    self.last_error = str(e)[:300]
+                logger.exception("online fold cycle failed; continuing")
+            self._wake.wait(self.config.interval_s)
+            self._wake.clear()
+
+    def _algo_enabled(self, algo) -> bool:
+        allow = self.config.algorithms
+        if not allow:
+            return True
+        name = type(algo).__name__.lower()
+        return any(tok and tok.lower() in name for tok in allow)
+
+    def _rebind(self, pairs, generation: int) -> None:
+        """(Re)build per-pair streaming trainers when the model
+        generation moved (a /reload swapped the models out from under
+        the previous binding)."""
+        with self._lock:
+            if generation == self._bound_generation:
+                return
+            stale = list(self._trainers.values())
+            self._trainers = {}
+            self._bound_generation = generation
+        for t in stale:
+            t.stop()
+        from predictionio_tpu.online.trainer import StreamingTrainer
+
+        for pi, (algo, model) in enumerate(pairs):
+            spec_fn = getattr(algo, "online_trainer_spec", None)
+            if spec_fn is None or not self._algo_enabled(algo):
+                continue
+            spec = spec_fn(model)
+            if not spec:
+                continue
+            cfg = self.config
+
+            def apply(update, _pi=pi, _gen=generation):
+                res = self.service.apply_online_update(
+                    [(_pi, update)], generation=_gen
+                )
+                if res.get("applied"):
+                    # the trainer applies asynchronously, outside the
+                    # fold cycle — freshness for streamed updates is
+                    # recorded here, stamped with the batch's newest
+                    # event time the trainer threads through
+                    self._record_visible(
+                        int(update.info.get("newestUs") or 0)
+                    )
+                return res
+
+            trainer = StreamingTrainer(
+                model,
+                apply,
+                batch_size=cfg.trainer_batch,
+                lr=spec.get("learning_rate", cfg.trainer_lr),
+                temperature=spec.get("temperature", 0.1),
+                seed=int(spec.get("seed", 0)),
+            )
+            with self._lock:
+                # stop() may have drained _trainers while this cycle was
+                # mid-rebind; registering now would leak a live daemon
+                # past close() — stop it instead (outside the lock: stop
+                # joins the trainer thread)
+                doomed = trainer if self._stop.is_set() else None
+                if doomed is None:
+                    self._trainers[pi] = trainer
+            if doomed is not None:
+                doomed.stop()
+
+    def _record_visible(self, newest_us: int) -> None:
+        """One event->serving-visible latency sample: the wall-clock gap
+        between a batch's newest event and its hot-swap completing."""
+        if not newest_us:
+            return
+        with self._lock:
+            self._visible_s.append(max(0.0, time.time() - newest_us / 1e6))
+            del self._visible_s[:-_SAMPLES]
+
+    def _cycle(self, deadline: float | None = None) -> dict:
+        with self._cycle_lock:
+            try:
+                return self._cycle_locked(deadline)
+            except Exception:
+                # the watermark must never advance past a batch that
+                # failed mid-fold (a transient hook/apply error would
+                # otherwise silently skip those events until the next
+                # retrain): drop the pending cursor so the next cycle
+                # re-delivers the whole batch
+                self.follower.rollback()
+                raise
+
+    def _cycle_locked(self, deadline: float | None = None) -> dict:
+        svc = self.service
+        pairs, generation = svc.snapshot_pairs()
+        self._rebind(pairs, generation)
+        events = self.follower.poll()
+        if not events:
+            return {"events": 0, "applied": False}
+        deltas = to_deltas(events)
+        newest_us = max((d.t_us for d in deltas), default=0)
+        applied_any = False
+        folded = 0
+        aborted: str | None = None
+        batch = self.config.batch_size
+        for lo in range(0, len(deltas), batch):
+            if deadline is not None and time.monotonic() > deadline:
+                aborted = "deadline"
+                break
+            chunk = deltas[lo : lo + batch]
+            t0 = time.perf_counter()
+            updates = []
+            for pi, (algo, model) in enumerate(pairs):
+                if not self._algo_enabled(algo):
+                    continue
+                with self._lock:
+                    trainer = self._trainers.get(pi)
+                if trainer is not None:
+                    names = self.ds_params.get("eventNames") or (
+                        "view", "rate", "buy", "like",
+                    )
+                    trainer.submit(
+                        [
+                            (d.user, d.item)
+                            for d in chunk
+                            if d.item is not None and d.event in names
+                        ],
+                        newest_us=max((d.t_us for d in chunk), default=0),
+                    )
+                    continue
+                hook = getattr(algo, "online_foldin", None)
+                if hook is None:
+                    continue
+                upd = hook(model, chunk, self.ds_params, self.config)
+                if upd is not None and not upd.empty:
+                    updates.append((pi, upd))
+            if updates:
+                res = svc.apply_online_update(updates, generation=generation)
+                if not res.get("applied") and res.get("reason"):
+                    # a concurrent /reload superseded the generation the
+                    # rows were solved against
+                    aborted = str(res["reason"])
+                    break
+                applied_any = applied_any or res.get("applied", False)
+            folded += len(chunk)
+            with self._lock:
+                self._fold_ms.append((time.perf_counter() - t0) * 1e3)
+                del self._fold_ms[:-_SAMPLES]
+        if aborted is not None:
+            # the watermark must never advance past events that were not
+            # applied: drop the poll advance so the next cycle re-delivers
+            # the whole batch against the current generation. Fold-in
+            # re-solves idempotently; the streaming trainer may re-see an
+            # already-trained chunk — its drop-oldest sampling queue
+            # absorbs the repeat.
+            self.follower.rollback()
+            return {
+                "events": len(events),
+                "applied": applied_any,
+                "requeued": True,
+                "reason": aborted,
+            }
+        self.follower.commit()
+        with self._lock:
+            self.folds += 1
+            self.events_seen += len(events)
+            self.events_folded += folded
+            self.last_error = None
+        if applied_any:
+            # wall-clock event->serving-visible latency: the batch's
+            # newest event was just swapped into the live model
+            self._record_visible(newest_us)
+        return {"events": len(events), "applied": applied_any}
+
+    # ---------------------------------------------------------------- stats
+    def stats_json(self) -> dict:
+        with self._lock:
+            fold_ms = list(self._fold_ms)
+            visible = list(self._visible_s)
+            trainers = {
+                str(pi): t.stats_json() for pi, t in self._trainers.items()
+            }
+            out = {
+                "folds": self.folds,
+                "eventsSeen": self.events_seen,
+                "eventsFolded": self.events_folded,
+                "intervalSeconds": self.config.interval_s,
+                "lastError": self.last_error,
+            }
+        out["foldMs"] = {
+            "p50": _percentile(fold_ms, 0.50),
+            "p95": _percentile(fold_ms, 0.95),
+            "last": fold_ms[-1] if fold_ms else None,
+        }
+        # measured event->reflected-in-recs latency (newest event of each
+        # applied batch to its hot-swap completing)
+        out["eventToVisibleSeconds"] = {
+            "p50": _percentile(visible, 0.50),
+            "p95": _percentile(visible, 0.95),
+            "last": visible[-1] if visible else None,
+        }
+        out["watermark"] = self.follower.lag()
+        if trainers:
+            out["trainers"] = trainers
+        return out

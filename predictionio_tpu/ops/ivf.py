@@ -59,11 +59,19 @@ __all__ = [
     "IVFIndex",
     "AnnRuntime",
     "build_ivf",
+    "update_ivf",
     "ivf_topk_batch",
     "ivf_topk_users",
     "query_topk",
     "auto_nlist",
 ]
+
+#: id-capacity rounding for incrementally grown indexes: ``num_items``
+#: is STATIC under jit (it is the sentinel and the mask bound), so every
+#: distinct value costs one retrace — growing it in 1024-item jumps
+#: means a steady trickle of cold-start items re-traces the query kernel
+#: once per ~1024 injections instead of once per fold
+_CAPACITY_STEP = 1024
 
 #: rows per chunk of the Lloyd assignment scan — bounds the transient
 #: [chunk, nlist] distance block at 64 MB for nlist=1024 instead of
@@ -326,6 +334,164 @@ def build_ivf(
 
 
 # ---------------------------------------------------------------------------
+# Incremental update: fold-in without a k-means rebuild
+# ---------------------------------------------------------------------------
+
+
+def _host_mirror(index: IVFIndex) -> dict:
+    """Mutable host-side view of an index for incremental maintenance:
+    numpy slab copies, per-cluster fill counts, and an item -> slab-slot
+    map. Built once per index generation, reused across folds."""
+    slabs = np.array(index.slabs, dtype=np.float32)
+    slab_ids = np.array(index.slab_ids, dtype=np.int32)
+    cents = np.asarray(index.centroids, dtype=np.float32)
+    sentinel = index.num_items
+    pos = np.full(sentinel, -1, np.int64)
+    cl, lane = np.nonzero(slab_ids != sentinel)
+    pos[slab_ids[cl, lane]] = cl * index.slab_width + lane
+    return {
+        "slabs": slabs,
+        "slab_ids": slab_ids,
+        "centroids": cents,
+        "c2": (cents * cents).sum(axis=1),
+        "fill": (slab_ids != sentinel).sum(axis=1).astype(np.int64),
+        "pos": pos,
+        "capacity": sentinel,
+    }
+
+
+def _grow_width(state: dict, extra: int) -> None:
+    nlist, width, dim = state["slabs"].shape
+    pad = max(1, extra, width // 4)
+    slabs = np.zeros((nlist, width + pad, dim), np.float32)
+    slabs[:, :width] = state["slabs"]
+    ids = np.full((nlist, width + pad), state["capacity"], np.int32)
+    ids[:, :width] = state["slab_ids"]
+    # re-derive positions: lane arithmetic changed with the width
+    pos = np.full(state["capacity"], -1, np.int64)
+    cl, lane = np.nonzero(ids != state["capacity"])
+    pos[ids[cl, lane]] = cl * (width + pad) + lane
+    state["slabs"] = slabs
+    state["slab_ids"] = ids
+    state["pos"] = pos
+
+
+def update_ivf(
+    index: IVFIndex,
+    item_ids: np.ndarray,
+    vectors: np.ndarray,
+    total_items: int,
+    state: dict | None = None,
+) -> tuple[IVFIndex, dict, dict]:
+    """Fold changed/new item vectors into an existing index WITHOUT a
+    k-means rebuild (ROADMAP PR-6 follow-up): each vector is assigned to
+    its nearest EXISTING centroid's slab, spilling to the nearest
+    cluster with room when the target slab is full (and growing the slab
+    width as a last resort). Centroids stay fixed — the point of
+    fold-in is that per-update cost scales with the delta, not the
+    catalog; a periodic full rebuild (every ``/reload``) re-learns them.
+
+    * an item already in the index whose nearest centroid is unchanged
+      updates its slab row in place;
+    * an item that MOVED clusters is tombstoned out of its old slab
+      (sentinel id, zero row) and re-inserted;
+    * a new item (``id >= capacity``) grows the id capacity in
+      :data:`_CAPACITY_STEP` jumps — capacity is the jit-static sentinel,
+      so stepping it bounds retraces.
+
+    ``state`` is the reusable host mirror from a previous call (pass the
+    second return value back in); None builds it from ``index``. Returns
+    ``(new index, state, info)``."""
+    if state is None:
+        state = _host_mirror(index)
+    item_ids = np.asarray(item_ids, np.int64)
+    vectors = np.ascontiguousarray(np.asarray(vectors, np.float32))
+    old_capacity = state["capacity"]
+    capacity = old_capacity
+    if total_items > capacity:
+        capacity = -(-total_items // _CAPACITY_STEP) * _CAPACITY_STEP
+        # rewrite the sentinel: padding slots must track the new bound
+        # (an item id equal to the OLD capacity is now a real id)
+        pad_mask = state["slab_ids"] == old_capacity
+        state["slab_ids"][pad_mask] = capacity
+        pos = np.full(capacity, -1, np.int64)
+        pos[: state["pos"].size] = state["pos"]
+        state["pos"] = pos
+        state["capacity"] = capacity
+    slabs = state["slabs"]
+    ids = state["slab_ids"]
+    fill = state["fill"]
+    pos = state["pos"]
+    width = slabs.shape[1]
+    # nearest-centroid preference order per changed item, via the GEMM
+    # identity (||x||^2 is row-constant); the delta is small, so the
+    # [M, nlist] block is cheap
+    keys = state["c2"][None, :] - 2.0 * (vectors @ state["centroids"].T)
+    prefs = np.argsort(keys, axis=1, kind="stable")
+    moved = inserted = in_place = spilled = 0
+    for iid, vec, pref in zip(item_ids.tolist(), vectors, prefs):
+        cur = pos[iid]
+        target = int(pref[0])
+        if cur >= 0:
+            cl, lane = divmod(int(cur), width)
+            if cl == target:
+                slabs[cl, lane] = vec
+                in_place += 1
+                continue
+            ids[cl, lane] = capacity  # tombstone out of the old slab
+            slabs[cl, lane] = 0.0
+            fill[cl] -= 1
+            pos[iid] = -1
+            moved += 1
+        else:
+            inserted += 1
+        placed = False
+        for rank_i, c in enumerate(pref.tolist()):
+            if fill[c] >= width:
+                continue
+            lane = int(np.argmax(ids[c] == capacity))
+            ids[c, lane] = iid
+            slabs[c, lane] = vec
+            fill[c] += 1
+            pos[iid] = c * width + lane
+            spilled += int(rank_i > 0)
+            placed = True
+            break
+        if not placed:  # every slab full: widen, then retry is trivial
+            _grow_width(state, 1)
+            slabs = state["slabs"]
+            ids = state["slab_ids"]
+            pos = state["pos"]
+            width = slabs.shape[1]
+            lane = int(np.argmax(ids[target] == capacity))
+            ids[target, lane] = iid
+            slabs[target, lane] = vec
+            fill[target] += 1
+            pos[iid] = target * width + lane
+    new_index = IVFIndex(
+        centroids=index.centroids,
+        # copies, not views: on CPU backends jnp.asarray adopts aligned
+        # numpy buffers zero-copy, and `state` mutates these arrays in
+        # place on the NEXT update while in-flight queries may still be
+        # scoring this index
+        slabs=jnp.asarray(slabs.copy()),
+        slab_ids=jnp.asarray(ids.copy()),
+        num_items=capacity,
+        nlist=index.nlist,
+        slab_width=width,
+    )
+    info = {
+        "inPlace": in_place,
+        "moved": moved,
+        "inserted": inserted,
+        "spilled": spilled,
+        "capacity": capacity,
+        "slabWidth": width,
+    }
+    return new_index, state, info
+
+
+# ---------------------------------------------------------------------------
 # Query: two-stage jitted retrieval
 # ---------------------------------------------------------------------------
 
@@ -420,6 +586,31 @@ class AnnRuntime:
         self.queries = 0
         self.clusters_scored = 0
         self.candidates_scored = 0
+        #: incremental-maintenance host mirror (built on first update)
+        self._update_state: dict | None = None
+        self.incremental_updates = 0
+        self.items_folded = 0
+
+    def update_items(
+        self, item_ids: np.ndarray, vectors: np.ndarray, total_items: int
+    ) -> dict:
+        """Fold changed/new item vectors into the live index — nearest-
+        centroid slab assignment with spill, no k-means rebuild (see
+        :func:`update_ivf`). Swaps ``self.index`` atomically; in-flight
+        queries that already snapshotted the old index finish against
+        it consistently."""
+        with self._lock:
+            state = self._update_state
+            index = self.index
+        new_index, state, info = update_ivf(
+            index, item_ids, vectors, total_items, state
+        )
+        with self._lock:
+            self.index = new_index
+            self._update_state = state
+            self.incremental_updates += 1
+            self.items_folded += len(np.asarray(item_ids))
+        return info
 
     def note_queries(self, n: int) -> None:
         """Account ``n`` queries' worth of scored clusters/candidates."""
@@ -439,11 +630,16 @@ class AnnRuntime:
             clusters = self.clusters_scored
             candidates = self.candidates_scored
         total = q * self.index.num_items
+        with self._lock:
+            inc = self.incremental_updates
+            folded = self.items_folded
         out = {
             "nprobe": self.nprobe,
             "queries": q,
             "clustersScored": clusters,
             "candidatesScored": candidates,
+            "incrementalUpdates": inc,
+            "itemsFolded": folded,
             # the headline number: what fraction of the catalog each
             # query paid for, vs 1.0 on the exact path
             "fractionOfCatalogScored": (

@@ -780,6 +780,196 @@ class ALSAlgorithm(JaxAlgorithm):
         if getattr(model, "_pio_ann", None) is not None:
             model._pio_ann = None
 
+    # --------------------------------------------------- online fold-in
+    @staticmethod
+    def _online_state(model: ALSModel, max_entities: int) -> dict:
+        """Per-model online rating accumulator (LRU-bounded per side):
+        the follower only sees events since deploy, so each touched
+        entity's re-solve uses its accumulated ONLINE history anchored
+        to its trained row (online/foldin.py). Dies with the model on a
+        full /reload — by then a retrain owns the history."""
+        state = getattr(model, "_pio_online", None)
+        if state is None:
+            from collections import OrderedDict
+
+            state = {
+                "users": OrderedDict(),
+                "items": OrderedDict(),
+                "max": max_entities,
+            }
+            model._pio_online = state
+        return state
+
+    @staticmethod
+    def _remember(side: "Any", key: str, other: str, t_us: int,
+                  rating: float, cap: int) -> None:
+        hist = side.get(key)
+        if hist is None:
+            hist = side[key] = {}
+        side.move_to_end(key)
+        prev = hist.get(other)
+        if prev is None or (t_us, rating) >= prev:
+            hist[other] = (t_us, rating)
+        while len(side) > cap:
+            side.popitem(last=False)
+
+    def online_foldin(self, model: ALSModel, deltas, ds_params, config):
+        """Compute re-solved rows for the users/items a delta batch
+        touched — fixed opposite-side factors, ALS-WR objective, prior
+        anchor (see online/foldin.py). Read-only: runs outside the
+        serving lock; ``apply_online_update`` swaps the rows in."""
+        from predictionio_tpu.online.foldin import foldin_rows, gram_yty
+        from predictionio_tpu.online.types import OnlineUpdate, latest_wins
+
+        p = self.params
+        rate_event = ds_params.get("rate_event", ds_params.get("rateEvent", "rate"))
+        buy_event = ds_params.get("buy_event", ds_params.get("buyEvent", "buy"))
+        buy_rating = float(
+            ds_params.get("buy_rating", ds_params.get("buyRating", 4.0))
+        )
+        # map the event mix to ratings, then collapse with the shared
+        # latest-wins rule (one source of truth with the training read)
+        rated = latest_wins(
+            [
+                dataclasses.replace(d, rating=buy_rating)
+                if d.event == buy_event
+                else d
+                for d in deltas
+                if d.event in (rate_event, buy_event)
+            ]
+        )
+        if not rated:
+            return None
+        state = self._online_state(model, config.max_entities)
+        for (u, i), (t_us, r) in rated.items():
+            self._remember(state["users"], u, i, t_us, r, state["max"])
+            self._remember(state["items"], i, u, t_us, r, state["max"])
+        touched_users = sorted({u for u, _ in rated})
+        touched_items = sorted({i for _, i in rated})
+        implicit = p.implicit_prefs
+        yty_item = yty_user = None
+        if implicit:
+            # the implicit objective's Gramian over the opposite factors,
+            # computed ONCE per model object (it dies with the model on
+            # /reload, when a retrain re-anchors everything). Folds move
+            # a few rows so the cached YtY drifts slightly — the same
+            # approximation MLlib's fold-in makes by using the
+            # training-time Gramian; recomputing O(N*K^2) per fold would
+            # turn the delta-cost fold into a full-catalog pass
+            if "yty_item" not in state:
+                state["yty_item"] = gram_yty(np.asarray(model.item_factors))
+                state["yty_user"] = gram_yty(np.asarray(model.user_factors))
+            yty_item = state["yty_item"]
+            yty_user = state["yty_user"]
+
+        def solve_side(touched, side_hist, own_factors, own_index,
+                       opp_index, opp_factors, yty):
+            ids, entries, prior_rows = [], [], []
+            n_own = int(own_factors.shape[0])
+            for ent in touched:
+                hist = side_hist.get(ent, {})
+                pairs = [
+                    (idx, r)
+                    for other, (_, r) in hist.items()
+                    if (idx := opp_index.get(other)) is not None
+                ]
+                if not pairs:
+                    continue  # nothing resolvable yet (opposite unseen)
+                row = own_index.get(ent)
+                ids.append(ent)
+                entries.append(([ix for ix, _ in pairs], [r for _, r in pairs]))
+                # -1 = cold start: pure fold-in from first events
+                prior_rows.append(
+                    row if row is not None and row < n_own else -1
+                )
+            if not ids:
+                return [], None
+            # gather ONLY the touched prior rows — for a pinned (device)
+            # table this is one on-device gather + a len(ids)-row
+            # transfer, never the whole table host-side per fold
+            prior_rows = np.asarray(prior_rows, np.int64)
+            known = prior_rows >= 0
+            if n_own:
+                gathered = np.asarray(
+                    own_factors[np.where(known, prior_rows, 0)], np.float32
+                )
+            else:
+                gathered = np.zeros(
+                    (len(ids), int(own_factors.shape[1])), np.float32
+                )
+            priors = np.where(known[:, None], gathered, 0.0).astype(np.float32)
+            weights = np.where(known, config.prior_weight, 0.0).astype(
+                np.float32
+            )
+            rows = foldin_rows(
+                opp_factors,
+                entries,
+                reg=p.lambda_,
+                priors=priors,
+                prior_weights=weights,
+                implicit=implicit,
+                alpha=p.alpha,
+                yty=yty,
+            )
+            return ids, rows
+
+        user_ids, user_rows = solve_side(
+            touched_users, state["users"], model.user_factors,
+            model.user_index, model.item_index, model.item_factors, yty_item,
+        )
+        item_ids, item_rows = solve_side(
+            touched_items, state["items"], model.item_factors,
+            model.item_index, model.user_index, model.user_factors, yty_user,
+        )
+        if not user_ids and not item_ids:
+            return None
+        return OnlineUpdate(
+            user_ids=user_ids,
+            user_rows=user_rows,
+            item_ids=item_ids,
+            item_rows=item_rows,
+            # every user who RATED in this batch sees changed results
+            # even when only the item side of their pair moved (e.g. a
+            # brand-new item they just rated) — their cached entries
+            # must die with the swap
+            extra_scopes=sorted({u for u, _ in rated}),
+            info={"ratings": len(rated)},
+        )
+
+    def apply_online_update(self, model: ALSModel, upd) -> dict:
+        """Swap the computed rows into the live model — called UNDER the
+        query service's generation lock, so it must stay cheap: row
+        scatters (on-device for pinned state), id-map extension for
+        cold starts, and the incremental IVF index update."""
+        from predictionio_tpu.workflow import device_state
+
+        info = {"usersUpdated": 0, "itemsUpdated": 0,
+                "usersAdded": 0, "itemsAdded": 0}
+        if upd.user_ids:
+            info["usersUpdated"], info["usersAdded"] = (
+                device_state.swap_side_rows(
+                    model, upd.user_ids, upd.user_rows,
+                    "user_factors", "user_index", rows_before_index=True,
+                )
+            )
+        if upd.item_ids:
+            info["itemsUpdated"], info["itemsAdded"] = (
+                device_state.swap_side_rows(
+                    model, upd.item_ids, upd.item_rows,
+                    "item_factors", "item_index", rows_before_index=False,
+                )
+            )
+            if info["itemsAdded"]:
+                # the batchpredict fast path caches per-item JSON
+                # prefixes by index — a grown catalog invalidates them
+                model._item_json_prefix = None
+            ann_info = device_state.update_ann_items(
+                model, upd.item_ids, upd.item_rows
+            )
+            if ann_info is not None:
+                info["ann"] = ann_info
+        return info
+
     def predict(self, model: ALSModel, query: Query) -> PredictedResult:
         uidx = model.user_index.get(query.user)
         if uidx is None:

@@ -419,6 +419,54 @@ class TwoTowerAlgorithm(JaxAlgorithm):
         if getattr(model, "_pio_ann", None) is not None:
             model._pio_ann = None
 
+    # ----------------------------------------------- online streaming SGD
+    def online_trainer_spec(self, model: TwoTowerServingModel) -> dict:
+        """Opt into the streaming mini-batch trainer (``pio deploy
+        --online``; online/trainer.py): towers have no closed-form
+        fold-in, so their online path is small SGD steps on fresh pairs
+        with the SAME in-batch softmax objective training uses."""
+        p = self.params
+        return {
+            "learning_rate": p.learning_rate,
+            "temperature": p.temperature,
+            "seed": p.seed,
+        }
+
+    def apply_online_update(self, model: TwoTowerServingModel, upd) -> dict:
+        """Swap streamed rows into the live towers — called under the
+        query service's generation lock (row scatters only; the SGD ran
+        on the trainer thread). Also grows the serving-time seen-item
+        filter with the folded pairs so fresh interactions filter out of
+        recommendations immediately, coherent with the row updates."""
+        from predictionio_tpu.workflow import device_state
+
+        info = {"usersUpdated": 0, "itemsUpdated": 0,
+                "usersAdded": 0, "itemsAdded": 0}
+        if upd.user_ids:
+            info["usersUpdated"], info["usersAdded"] = (
+                device_state.swap_side_rows(
+                    model, upd.user_ids, upd.user_rows,
+                    "user_vecs", "user_index", rows_before_index=True,
+                )
+            )
+        if upd.item_ids:
+            info["itemsUpdated"], info["itemsAdded"] = (
+                device_state.swap_side_rows(
+                    model, upd.item_ids, upd.item_rows,
+                    "item_vecs", "item_index", rows_before_index=False,
+                )
+            )
+            ann_info = device_state.update_ann_items(
+                model, upd.item_ids, upd.item_rows
+            )
+            if ann_info is not None:
+                info["ann"] = ann_info
+        for u, i in upd.seen_pairs:
+            # copy-on-write per user: a reader iterating the old set must
+            # never observe a concurrent mutation
+            model.seen[u] = set(model.seen.get(u, ())) | {i}
+        return info
+
     def batch_predict(
         self, model: TwoTowerServingModel, queries
     ) -> list[tuple[int, PredictedResult]]:

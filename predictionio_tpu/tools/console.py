@@ -263,6 +263,45 @@ def build_parser() -> argparse.ArgumentParser:
         "--ann-kmeans-iters", type=_int_at_least(0), default=8, metavar="N",
         help="Lloyd iterations after k-means++ seeding (default 8)",
     )
+    # ---- online learning (predictionio_tpu.online; docs/operations.md).
+    # Strictly opt-in: without --online no follower thread starts and the
+    # serving path is byte-identical to a build without the subsystem.
+    deploy.add_argument(
+        "--online", action="store_true",
+        help="tail the event store and fold fresh events into the live "
+        "model without a retrain: incremental ALS fold-in / streaming "
+        "two-tower mini-batches, hot-swapped row-by-row with per-scope "
+        "cache invalidation and incremental IVF index updates "
+        "(/stats.json grows an 'online' section; columnar event store "
+        "required)",
+    )
+    deploy.add_argument(
+        "--online-interval-s", type=float, default=1.0, metavar="S",
+        help="seconds between watermark polls of the event tail "
+        "(default 1.0)",
+    )
+    deploy.add_argument(
+        "--online-batch", type=_int_at_least(1), default=4096, metavar="N",
+        help="most events folded per batch; larger bursts fold over "
+        "consecutive batches (default 4096)",
+    )
+    deploy.add_argument(
+        "--online-algos", default="", metavar="NAMES",
+        help="comma-separated algorithm-class allowlist (e.g. "
+        "'als,twotower'); empty (default) = every deployed algorithm "
+        "that implements the online hooks",
+    )
+    deploy.add_argument(
+        "--online-prior-weight", type=float, default=1.0, metavar="W",
+        help="anchor strength toward each entity's trained row in the "
+        "fold-in re-solve; 0 = pure fold-in from online-observed events "
+        "(default 1.0)",
+    )
+    deploy.add_argument(
+        "--online-from-start", action="store_true",
+        help="fold events already in the store at deploy time too "
+        "(default: start at the end of the stream)",
+    )
     # ---- resilience (predictionio_tpu.resilience; docs/operations.md).
     # Defaults are the do-nothing configuration: single-attempt storage
     # calls, no breaker — identical to a build without these flags.
@@ -731,9 +770,25 @@ def main(argv: list[str] | None = None) -> int:
                     seed=args.ann_seed,
                     kmeans_iters=args.ann_kmeans_iters,
                 )
+            online = None
+            if args.online:
+                from predictionio_tpu.online import OnlineConfig
+
+                online = OnlineConfig(
+                    enabled=True,
+                    interval_s=args.online_interval_s,
+                    batch_size=args.online_batch,
+                    algorithms=tuple(
+                        t.strip()
+                        for t in args.online_algos.split(",")
+                        if t.strip()
+                    ),
+                    prior_weight=args.online_prior_weight,
+                    from_start=args.online_from_start,
+                )
             service = QueryService(
                 variant, feedback=feedback, instance_id=args.engine_instance_id,
-                batching=batching, cache=cache, ann=ann,
+                batching=batching, cache=cache, ann=ann, online=online,
             )
 
             def wire_stop(server):

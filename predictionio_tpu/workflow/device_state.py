@@ -39,7 +39,15 @@ from __future__ import annotations
 import logging
 from typing import Sequence
 
-__all__ = ["pin_pairs", "release_pairs", "build_ann_pairs"]
+__all__ = [
+    "pin_pairs",
+    "release_pairs",
+    "build_ann_pairs",
+    "set_rows",
+    "append_rows",
+    "swap_side_rows",
+    "update_ann_items",
+]
 
 logger = logging.getLogger(__name__)
 
@@ -115,6 +123,118 @@ def build_ann_pairs(pairs: Sequence, ann_config) -> tuple[list, list]:
             )
         out.append((algo, model))
     return out, infos
+
+
+def set_rows(mat, idx, rows):
+    """Replace factor rows ``idx`` of ``mat`` with ``rows`` — the online
+    fold-in's delta re-pin (ROADMAP item 3).
+
+    Pinned (device-resident) state updates via an on-device scatter, so
+    only the touched rows cross the host->device link instead of
+    re-staging the whole table per fold; host arrays update
+    copy-on-write and swap whole (an in-place row write could hand a
+    concurrent reader a torn vector — attribute assignment of the new
+    array is atomic, the old array stays internally consistent for any
+    in-flight query that already grabbed it)."""
+    import numpy as np
+
+    if isinstance(mat, np.ndarray):
+        out = mat.copy()
+        out[np.asarray(idx, np.int64)] = np.asarray(rows, mat.dtype)
+        return out
+    import jax.numpy as jnp
+
+    return mat.at[jnp.asarray(np.asarray(idx, np.int32))].set(
+        jnp.asarray(np.asarray(rows), dtype=mat.dtype)
+    )
+
+
+def append_rows(mat, rows):
+    """Grow a factor table by cold-start rows (fold-in injection for
+    never-seen entities); stays on device when the table is pinned."""
+    import numpy as np
+
+    if isinstance(mat, np.ndarray):
+        return np.concatenate([mat, np.asarray(rows, mat.dtype)], axis=0)
+    import jax.numpy as jnp
+
+    return jnp.concatenate(
+        [mat, jnp.asarray(np.asarray(rows), dtype=mat.dtype)], axis=0
+    )
+
+
+def swap_side_rows(
+    model, ids, rows, factors_attr: str, index_attr: str,
+    rows_before_index: bool,
+) -> tuple[int, int]:
+    """Swap one side's online-update rows into a live model: split
+    ``ids`` into known (scatter via :func:`set_rows`) and new
+    (cold-start: :func:`append_rows` + ``BiMap.extended``), mutating the
+    model's attributes by whole-object assignment only. The ONE place
+    that encodes the swap-ordering contract both templates rely on:
+
+    ``rows_before_index=True`` (user side) — a racing query resolving a
+    fresh user must find its row already present (the reverse order
+    could hand it an out-of-bounds row); until the index lands, the user
+    just reads as unknown.
+
+    ``rows_before_index=False`` (item side) — scoring runs over the
+    factor table, so a new row must not become rankable before the index
+    can translate it back to an item id.
+
+    Returns ``(rows updated, rows added)``."""
+    import numpy as np
+
+    index = getattr(model, index_attr)
+    known = [
+        (j, idx)
+        for j, e in enumerate(ids)
+        if (idx := index.get(e)) is not None
+    ]
+    new = [j for j, e in enumerate(ids) if index.get(e) is None]
+    rows = np.asarray(rows, np.float32)
+    if known:
+        setattr(
+            model,
+            factors_attr,
+            set_rows(
+                getattr(model, factors_attr),
+                [idx for _, idx in known],
+                rows[[j for j, _ in known]],
+            ),
+        )
+    if new:
+        new_ids = [ids[j] for j in new]
+        if rows_before_index:
+            setattr(
+                model,
+                factors_attr,
+                append_rows(getattr(model, factors_attr), rows[new]),
+            )
+            setattr(model, index_attr, index.extended(new_ids))
+        else:
+            setattr(model, index_attr, index.extended(new_ids))
+            setattr(
+                model,
+                factors_attr,
+                append_rows(getattr(model, factors_attr), rows[new]),
+            )
+    return len(known), len(new)
+
+
+def update_ann_items(model, item_ids, rows, index_attr: str = "item_index"):
+    """Fold changed/new item rows into the model's incremental IVF index
+    (when one is built); returns the update info dict or ``None``."""
+    import numpy as np
+
+    ann = getattr(model, "_pio_ann", None)
+    if ann is None:
+        return None
+    index = getattr(model, index_attr)
+    all_idx = np.asarray([index[i] for i in item_ids], np.int64)
+    return ann.update_items(
+        all_idx, np.asarray(rows, np.float32), total_items=len(index)
+    )
 
 
 def release_pairs(pairs: Sequence) -> None:

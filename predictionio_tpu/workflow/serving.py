@@ -130,6 +130,7 @@ class QueryService:
         batching: BatcherConfig | None = None,
         cache: CacheConfig | None = None,
         ann: AnnConfig | None = None,
+        online=None,
     ):
         self.variant = variant
         self.ctx = ctx or local_context()
@@ -212,7 +213,23 @@ class QueryService:
                 )
                 resilience.register_stats("feedback", self._feedback_breaker)
             threading.Thread(target=self._feedback_worker, daemon=True).start()
+        # online learning (pio deploy --online; docs/operations.md).
+        # Strictly opt-in: online=None (or a disabled config) starts no
+        # follower thread and leaves serving byte-identical — with the
+        # flag off, predictionio_tpu.online is never even imported
+        # (CI-guarded like batching/caching/ann/resilience)
+        self.online_config = (
+            online if online is not None and online.enabled else None
+        )
+        self.online = None
+        #: monotonically increments on every applied partial update —
+        #: the freshness counter beside the (full-reload) generation
+        self._online_updates = 0
         self.reload()
+        if self.online_config is not None:
+            from predictionio_tpu.online.runner import OnlineRunner
+
+            self.online = OnlineRunner(self, self.online_config)
         # cross-request micro-batching (predictionio_tpu.serving): when
         # enabled, /queries.json routes through the batcher so concurrent
         # requests share one handle_batch dispatch. Created AFTER reload()
@@ -540,6 +557,80 @@ class QueryService:
                 count += 1
         return {"invalidated": count, "flushed": False}
 
+    # ------------------------------------------------------ online fold-in
+    def snapshot_pairs(self) -> tuple[list, int]:
+        """Consistent (pairs, model generation) snapshot — what the
+        online runner computes updates against; the generation token
+        comes back through :meth:`apply_online_update` so updates
+        computed against a superseded generation are dropped."""
+        with self._lock:
+            return list(self._algo_model_pairs), self._model_generation
+
+    def apply_online_update(
+        self, updates: Sequence[tuple[int, Any]], generation: int | None = None
+    ) -> dict:
+        """The partial-update hot swap beside ``/reload`` (ROADMAP item
+        3): swap ONLY the touched factor rows of the live models, under
+        the same generation lock a full reload uses.
+
+        ``updates`` is ``[(pair index, OnlineUpdate), ...]`` — each
+        pair's algorithm applies its own update (row scatters, cold-start
+        id injection, incremental IVF maintenance; see the templates'
+        ``apply_online_update`` hooks). ``generation`` (from
+        :meth:`snapshot_pairs`) guards against a concurrent ``/reload``:
+        rows solved against superseded factors are dropped, never folded
+        into the new generation.
+
+        Cache contract (docs/serving.md): unlike ``/reload`` — which
+        flushes everything because the whole model moved — a partial
+        update bumps ONLY the touched per-scope counters, so unrelated
+        hot entries survive a fold-in. Untouched users' rankings can
+        drift when item rows move; the result-cache TTL bounds that
+        staleness, same as any event-driven invalidation miss.
+
+        Locking: the generation check and the pair snapshot happen under
+        the lock; the row swaps themselves run OUTSIDE it. Each pair has
+        exactly ONE online writer (the runner's cycle lock / its
+        trainer thread), every mutation is an atomic whole-object
+        attribute swap ordered so racing readers stay consistent, and a
+        concurrent ``/reload`` only ever swaps in NEW model objects — a
+        hook finishing against the superseded objects is then harmless.
+        Holding the serving lock through the (numpy-bound) hooks was
+        measured to convoy concurrent queries straight into the p99
+        tail on every fold."""
+        with self._lock:
+            if generation is not None and generation != self._model_generation:
+                return {"applied": False, "reason": "superseded generation"}
+            pairs = list(self._algo_model_pairs)
+        infos: list[dict] = []
+        scopes: set[str] = set()
+        try:
+            for pair_idx, upd in updates:
+                if upd is None or getattr(upd, "empty", True):
+                    continue
+                if not 0 <= pair_idx < len(pairs):
+                    continue
+                algo, model = pairs[pair_idx]
+                hook = getattr(algo, "apply_online_update", None)
+                if hook is None:
+                    continue
+                # scopes BEFORE the hook: if it raises mid-swap, the
+                # touched users' cached results may already reflect a
+                # partial row swap and must die with it — the finally
+                # below invalidates them even on the error path
+                scopes.update(upd.touched_scopes())
+                infos.append(hook(model, upd))
+        finally:
+            if infos:
+                with self._lock:
+                    self._online_updates += 1
+            if scopes:
+                # per-scope, never a full flush (the fold-in cache
+                # satellite)
+                self.cache_note_write(sorted(scopes))
+        return {"applied": bool(infos), "infos": infos,
+                "scopes": len(scopes)}
+
     def handle_batch(
         self, bodies: Sequence[Any], n_real: int | None = None
     ) -> list[tuple[int, Any]]:
@@ -666,6 +757,11 @@ class QueryService:
         fb = self.feedback
         assert fb is not None
         event = {
+            # deterministic client eventId derived from the prediction id:
+            # the worker's POST becomes retry-safe under the event store's
+            # client-id dedup — a redelivered feedback event answers
+            # "duplicate", never double-counts (docs/eventserver.md)
+            "eventId": f"pio_fb_{pr_id}",
             "event": "predict",
             "entityType": "pio_pr",
             "entityId": pr_id or "",
@@ -705,6 +801,7 @@ class QueryService:
             "batching": self.batcher is not None,
             "caching": self.cache_config is not None,
             "ann": self.ann_config is not None,
+            "online": self.online is not None,
             # degraded-mode semantics (docs/operations.md): serving the
             # last-good model after a failed reload
             "degraded": self.degraded,
@@ -748,6 +845,15 @@ class QueryService:
             # hit/miss/coalesced counters, eviction + invalidation
             # breakdown, bytes pinned (docs/performance.md)
             out["cache"] = self._cache_stats.to_json()
+        if self.online is not None:
+            # freshness decomposition (docs/operations.md): events
+            # folded, fold latency, watermark lag, and the measured
+            # event->reflected-in-recs latency of applied batches
+            with self._lock:
+                applied = self._online_updates
+            out["online"] = dict(
+                self.online.stats_json(), updatesApplied=applied
+            )
         if self.ann_config is not None:
             # approximate-retrieval decomposition (docs/serving.md):
             # effective nlist/nprobe plus, per built index, clusters
@@ -787,8 +893,12 @@ class QueryService:
         return report
 
     def close(self) -> None:
-        """Release background resources (the batcher's dispatcher thread).
-        Safe to call more than once; queued requests get a 503."""
+        """Release background resources (the batcher's dispatcher thread
+        and the online follower/trainer threads). Safe to call more than
+        once; queued requests get a 503."""
+        if self.online is not None:
+            self.online.stop()
+            self.online = None
         if self.batcher is not None:
             self.batcher.close()
 
@@ -870,6 +980,20 @@ class QueryService:
             return Response(200, self.cache_note_write(scopes, flush_all))
         if path == "/stats.json" and method == "GET":
             return Response(200, self.stats_json())
+        if path == "/online/fold.json" and method == "POST":
+            # the partial-update entry point beside /reload: poll the
+            # tail and fold whatever landed, synchronously (the daemon
+            # keeps its own cadence; this is the operator/test trigger)
+            if self.online is None:
+                return Response(
+                    404,
+                    {"message": "Online learning is off on this deployment "
+                                "(enable with pio deploy --online)."},
+                )
+            try:
+                return Response(200, self.online.fold_now())
+            except Exception as e:
+                return Response(500, {"message": str(e)[:300]})
         if path == "/reload" and method == "POST":
             try:
                 self.reload()
