@@ -32,6 +32,7 @@ from predictionio_tpu.analysis.engine import (
     LintResult,
     all_rules,
     lint_file,
+    lint_sources,
     lint_tree,
     run_lint,
 )
@@ -42,6 +43,7 @@ from predictionio_tpu.analysis import rules_layering  # noqa: F401  (registry)
 from predictionio_tpu.analysis import rules_concurrency  # noqa: F401
 from predictionio_tpu.analysis import rules_jax  # noqa: F401
 from predictionio_tpu.analysis import rules_server  # noqa: F401
+from predictionio_tpu.analysis import rules_program  # noqa: F401  (PIO206+)
 
 __all__ = [
     "DEFAULT_MANIFEST",
@@ -50,6 +52,7 @@ __all__ = [
     "PackageRule",
     "all_rules",
     "lint_file",
+    "lint_sources",
     "lint_tree",
     "run_lint",
 ]

@@ -34,8 +34,11 @@ __all__ = [
     "LintResult",
     "all_rules",
     "lint_file",
+    "lint_sources",
     "lint_tree",
     "load_baseline",
+    "program_rule",
+    "prune_baseline",
     "rule",
     "run_lint",
     "write_baseline",
@@ -62,15 +65,20 @@ _DISABLE_FILE_RE = re.compile(r"#\s*piolint:\s*disable-file=([A-Za-z0-9,\s]+)")
 class Finding:
     """One diagnostic. ``path`` is repo-relative posix; ``message`` must
     be stable across unrelated edits (no line numbers, no volatile
-    state) because the baseline keys on (code, path, message)."""
+    state) because the baseline keys on (code, path, message). Anything
+    volatile but useful — a shortest call chain that changes whenever an
+    unrelated refactor adds a shorter path — goes in ``detail``: shown
+    by :meth:`render`, never part of the baseline key."""
 
     code: str
     path: str
     line: int
     message: str
+    detail: str = ""
 
     def render(self) -> str:
-        return f"{self.path}:{self.line}: {self.code} {self.message}"
+        tail = f" [{self.detail}]" if self.detail else ""
+        return f"{self.path}:{self.line}: {self.code} {self.message}{tail}"
 
     def key(self) -> tuple[str, str, str]:
         return (self.code, self.path, self.message)
@@ -81,7 +89,11 @@ class Rule:
     code: str
     name: str
     description: str
-    check: Callable[["FileContext"], Iterable[Finding]]
+    check: Callable[..., Iterable[Finding]]
+    #: program-scope rules receive a ProgramContext (every parsed file +
+    #: the cross-module call graph) instead of one FileContext, and run
+    #: once per tree instead of once per file
+    program: bool = False
 
 
 #: code -> Rule; populated by the :func:`rule` decorator at import time
@@ -97,6 +109,22 @@ def rule(code: str, name: str, description: str):
         if code in _RULES:
             raise ValueError(f"duplicate piolint rule code {code}")
         _RULES[code] = Rule(code, name, description, fn)
+        return fn
+
+    return deco
+
+
+def program_rule(code: str, name: str, description: str):
+    """Register a whole-program rule (``PIO206``–``PIO209``). The
+    function receives a :class:`~predictionio_tpu.analysis.callgraph
+    .ProgramContext` and yields findings anywhere in the tree; inline
+    suppressions on the reported line and the baseline apply exactly as
+    for per-file rules."""
+
+    def deco(fn):
+        if code in _RULES:
+            raise ValueError(f"duplicate piolint rule code {code}")
+        _RULES[code] = Rule(code, name, description, fn, program=True)
         return fn
 
     return deco
@@ -188,9 +216,17 @@ class FileContext:
         return ".".join(reversed(parts))
 
     # -------------------------------------------------------------- helpers
-    def finding(self, code: str, node: ast.AST | int, message: str) -> Finding:
+    def finding(
+        self, code: str, node: ast.AST | int, message: str, detail: str = ""
+    ) -> Finding:
         line = node if isinstance(node, int) else getattr(node, "lineno", 1)
-        return Finding(code=code, path=self.rel_path, line=line, message=message)
+        return Finding(
+            code=code,
+            path=self.rel_path,
+            line=line,
+            message=message,
+            detail=detail,
+        )
 
     # --------------------------------------------------------- suppressions
     def file_suppressions(self) -> set[str]:
@@ -221,6 +257,35 @@ class FileContext:
 # ---------------------------------------------------------------------------
 
 
+def _parse_failure(rel_path: str, e: SyntaxError) -> Finding:
+    """The one ``PIO100`` shape — the baseline keys on this message, so
+    there must be exactly one place that spells it."""
+    return Finding(
+        "PIO100",
+        rel_path.replace(os.sep, "/"),
+        e.lineno or 1,
+        "file does not parse",
+    )
+
+
+def _lint_context(ctx: FileContext) -> tuple[list[Finding], int]:
+    """Run every per-file rule on one parsed module with suppression
+    accounting — the single body behind both :func:`lint_file` and the
+    per-file half of :func:`lint_sources`."""
+    file_codes = ctx.file_suppressions()
+    kept: list[Finding] = []
+    suppressed = 0
+    for r in _RULES.values():
+        if r.program:
+            continue  # program rules need the whole tree (lint_tree)
+        for f in r.check(ctx):
+            if ctx.is_suppressed(f, file_codes):
+                suppressed += 1
+            else:
+                kept.append(f)
+    return kept, suppressed
+
+
 def lint_file(
     rel_path: str, source: str, manifest: Manifest | None = None
 ) -> tuple[list[Finding], int]:
@@ -231,27 +296,8 @@ def lint_file(
     try:
         ctx = FileContext(rel_path, source, manifest)
     except SyntaxError as e:
-        return (
-            [
-                Finding(
-                    "PIO100",
-                    rel_path.replace(os.sep, "/"),
-                    e.lineno or 1,
-                    "file does not parse",
-                )
-            ],
-            0,
-        )
-    file_codes = ctx.file_suppressions()
-    kept: list[Finding] = []
-    suppressed = 0
-    for r in _RULES.values():
-        for f in r.check(ctx):
-            if ctx.is_suppressed(f, file_codes):
-                suppressed += 1
-            else:
-                kept.append(f)
-    return kept, suppressed
+        return [_parse_failure(rel_path, e)], 0
+    return _lint_context(ctx)
 
 
 def iter_tree_files(root: str) -> Iterator[tuple[str, str]]:
@@ -271,23 +317,77 @@ def iter_tree_files(root: str) -> Iterator[tuple[str, str]]:
             yield abs_path, os.path.relpath(abs_path, root)
 
 
+def lint_sources(
+    files: dict[str, str], manifest: Manifest | None = None
+) -> tuple[list[Finding], int, dict, list[dict]]:
+    """Lint a set of ``{rel_path: source}`` modules as one program:
+    per-file rules on each module, then the whole-program rules
+    (``PIO206``+) over the cross-module call graph built from every
+    module that parsed. Returns ``(findings, suppressed_count,
+    callgraph_stats, lock_order_cycles)`` — the cycle set is the one the
+    ``PIO207`` rule already computed (memoized on the program context),
+    handed out so the witness classification and the bench ``lint``
+    section never rebuild the graph for it."""
+    manifest = manifest or DEFAULT_MANIFEST
+    findings: list[Finding] = []
+    suppressed = 0
+    contexts: dict[str, FileContext] = {}
+    for rel_path in sorted(files):
+        source = files[rel_path]
+        try:
+            ctx = FileContext(rel_path, source, manifest)
+        except SyntaxError as e:
+            findings.append(_parse_failure(rel_path, e))
+            continue
+        contexts[ctx.rel_path] = ctx
+        kept, sup = _lint_context(ctx)
+        findings.extend(kept)
+        suppressed += sup
+    # program scope: build the call graph once, run every program rule,
+    # then apply the same per-line/per-file suppressions via the context
+    # each finding lands in
+    from predictionio_tpu.analysis.callgraph import ProgramContext, build_callgraph
+
+    graph = build_callgraph(contexts)
+    program = ProgramContext(contexts, graph)
+    file_codes = {p: c.file_suppressions() for p, c in contexts.items()}
+    for r in _RULES.values():
+        if not r.program:
+            continue
+        for f in r.check(program):
+            ctx = contexts.get(f.path)
+            if ctx is not None and ctx.is_suppressed(f, file_codes[f.path]):
+                suppressed += 1
+            else:
+                findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.code))
+    stats = {
+        "functions": len(graph.functions),
+        "classes": len(graph.classes),
+        "callEdges": sum(
+            len(s.callees) for fi in graph.functions.values() for s in fi.calls
+        ),
+        "lockSites": sum(
+            len(fi.acquisitions) for fi in graph.functions.values()
+        ),
+    }
+    from predictionio_tpu.analysis.rules_program import lock_order_cycles
+
+    return findings, suppressed, stats, lock_order_cycles(program)
+
+
 def lint_tree(
     root: str, manifest: Manifest | None = None
-) -> tuple[list[Finding], int, int]:
-    """Lint every file under ``root``. Returns
-    ``(findings, files_scanned, suppressed_count)``."""
-    findings: list[Finding] = []
-    files = 0
-    suppressed = 0
+) -> tuple[list[Finding], int, int, dict, list[dict]]:
+    """Lint every file under ``root``. Returns ``(findings,
+    files_scanned, suppressed_count, callgraph_stats,
+    lock_order_cycles)``."""
+    files: dict[str, str] = {}
     for abs_path, rel_path in iter_tree_files(root):
-        files += 1
         with open(abs_path, "r", encoding="utf-8", errors="replace") as fh:
-            source = fh.read()
-        found, sup = lint_file(rel_path, source, manifest)
-        findings.extend(found)
-        suppressed += sup
-    findings.sort(key=lambda f: (f.path, f.line, f.code))
-    return findings, files, suppressed
+            files[rel_path] = fh.read()
+    findings, suppressed, stats, cycles = lint_sources(files, manifest)
+    return findings, len(files), suppressed, stats, cycles
 
 
 # ---------------------------------------------------------------------------
@@ -369,6 +469,13 @@ class LintResult:
     baselined: list[Finding]
     suppressed_count: int
     stale_baseline: int  # baseline entries no current finding matched
+    #: whole-program pass sizes (functions/classes/callEdges/lockSites)
+    callgraph: dict = dataclasses.field(default_factory=dict)
+    #: stale entries removed by --prune-baseline (0 when not pruning)
+    pruned_baseline: int = 0
+    #: the PIO207 lock-order cycle set from this pass, for the witness
+    #: CONFIRMED/PLAUSIBLE join — consumers must not re-parse the tree
+    lock_cycles: list = dataclasses.field(default_factory=list)
 
     @property
     def ok(self) -> bool:
@@ -389,7 +496,10 @@ class LintResult:
             "baselinedCount": len(self.baselined),
             "suppressedCount": self.suppressed_count,
             "staleBaselineEntries": self.stale_baseline,
+            "prunedBaselineEntries": self.pruned_baseline,
             "countsByCode": self.counts_by_code(),
+            "callgraph": dict(self.callgraph),
+            "lockOrderCycles": len(self.lock_cycles),
         }
 
 
@@ -400,23 +510,68 @@ def default_root() -> str:
     return os.path.dirname(pkg)
 
 
+def prune_baseline(findings: list[Finding], path: str) -> int:
+    """Drop baseline entries no current finding matches, and cap each
+    surviving entry's ``count`` at the number of identical findings that
+    still fire (``pio lint --prune-baseline``). Justifications survive.
+    Returns the number of entries removed or shrunk. A missing baseline
+    file is a no-op (nothing to prune)."""
+    old = load_baseline(path)
+    if not old:
+        return 0
+    counts: dict[tuple[str, str, str], int] = {}
+    for f in findings:
+        counts[f.key()] = counts.get(f.key(), 0) + 1
+    entries = []
+    pruned = 0
+    for key, entry in sorted(old.items()):
+        live = min(entry["count"], counts.get(key, 0))
+        if live < entry["count"]:
+            pruned += 1
+        if live <= 0:
+            continue
+        code, fpath, message = key
+        entries.append(
+            {
+                "code": code,
+                "path": fpath,
+                "message": message,
+                "count": live,
+                "justification": entry.get("justification", ""),
+            }
+        )
+    if pruned:
+        with open(path, "w", encoding="utf-8") as fh:
+            json.dump(
+                {"version": 1, "entries": entries}, fh, indent=2, sort_keys=True
+            )
+            fh.write("\n")
+    return pruned
+
+
 def run_lint(
     root: str | None = None,
     baseline_path: str | None = None,
     update_baseline: bool = False,
     manifest: Manifest | None = None,
+    prune_stale: bool = False,
 ) -> LintResult:
     """Lint the tree under ``root`` against the checked-in baseline.
 
     ``update_baseline=True`` rewrites the baseline file to exactly the
     current findings (preserving justifications) and reports them all as
     baselined — the follow-up commit review supplies the justifications.
+    ``prune_stale=True`` instead only REMOVES baseline entries that no
+    current finding matches (fixed findings), never adding any.
     """
     root = os.path.abspath(root or default_root())
     baseline_path = baseline_path or os.path.join(root, BASELINE_NAME)
-    findings, files, suppressed = lint_tree(root, manifest)
+    findings, files, suppressed, cg_stats, cycles = lint_tree(root, manifest)
     if update_baseline:
         write_baseline(findings, baseline_path)
+    pruned = 0
+    if prune_stale and not update_baseline:
+        pruned = prune_baseline(findings, baseline_path)
     baseline = load_baseline(baseline_path)
     new, old = split_by_baseline(findings, baseline)
     matched_keys = {f.key() for f in old}
@@ -428,4 +583,7 @@ def run_lint(
         baselined=old,
         suppressed_count=suppressed,
         stale_baseline=stale,
+        callgraph=cg_stats,
+        pruned_baseline=pruned,
+        lock_cycles=cycles,
     )

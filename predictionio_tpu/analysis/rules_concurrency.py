@@ -464,18 +464,43 @@ def check_unbounded_dict_cache(ctx: FileContext) -> Iterator[Finding]:
 @rule(
     "PIO204",
     "thread-daemon-implicit",
-    "threading.Thread(...) without an explicit daemon= keyword",
+    "threading.Thread(...) without an explicit daemon= keyword, or a "
+    "ThreadPoolExecutor without a bounded max_workers",
 )
 def check_thread_daemon(ctx: FileContext) -> Iterator[Finding]:
     for node in ast.walk(ctx.tree):
         if not isinstance(node, ast.Call):
             continue
-        if ctx.dotted_name(node.func) != "threading.Thread":
-            continue
-        if not any(k.arg == "daemon" for k in node.keywords):
-            yield ctx.finding(
-                "PIO204",
-                node,
-                "threading.Thread without explicit daemon= (an implicit "
-                "non-daemon thread blocks interpreter shutdown)",
-            )
+        dotted = ctx.dotted_name(node.func)
+        if dotted == "threading.Thread":
+            if not any(k.arg == "daemon" for k in node.keywords):
+                yield ctx.finding(
+                    "PIO204",
+                    node,
+                    "threading.Thread without explicit daemon= (an "
+                    "implicit non-daemon thread blocks interpreter "
+                    "shutdown)",
+                )
+        elif dotted in (
+            "concurrent.futures.ThreadPoolExecutor",
+            "concurrent.futures.thread.ThreadPoolExecutor",
+        ):
+            # the default pool size scales with the host's core count
+            # (min(32, cpu+4)): a server that constructs one per request
+            # or runs on a big host silently multiplies its thread count.
+            # An explicit bound — positional or keyword, and not None —
+            # is the contract.
+            bound = node.args[0] if node.args else None
+            for k in node.keywords:
+                if k.arg == "max_workers":
+                    bound = k.value
+            if bound is None or (
+                isinstance(bound, ast.Constant) and bound.value is None
+            ):
+                yield ctx.finding(
+                    "PIO204",
+                    node,
+                    "ThreadPoolExecutor without a bounded max_workers "
+                    "(the default scales with host cores; pass an "
+                    "explicit bound)",
+                )

@@ -42,6 +42,7 @@ import os
 import shutil
 import threading
 import uuid
+import zlib
 from typing import Any, Iterable, Iterator, Sequence
 
 import numpy as np
@@ -722,6 +723,94 @@ class _ColumnarEvents(LEvents):
     #: consumed, even across a process restart straddling the compaction.
     _FOLLOW_CHAIN = 64
 
+    #: how many trailing bytes of the consumed prefix the cursor
+    #: checksums — catches a recovery trim (or any rewrite) that shifted
+    #: the byte layout under a persisted ``tail_bytes`` offset
+    _CRC_WINDOW = 64
+
+    @staticmethod
+    def _scan_tail_bytes(
+        path: str, offset: int
+    ) -> tuple[list[dict], int | None, int | None]:
+        """Decode tail lines from byte ``offset`` to EOF. Returns
+        ``(objs, end, crc)``: ``end`` is the exclusive byte offset of
+        the cleanly consumed region — it only advances across lines that
+        both decode AND end in a newline, and collapses to None the
+        moment anything torn/unterminated is seen (the cursor then falls
+        back to decodable-line counting, the pre-offset behavior).
+        ``crc`` covers the last ``_CRC_WINDOW`` bytes before ``end``.
+        Decodable-but-dirty lines are still decoded and counted, exactly
+        like the non-offset scan."""
+        objs: list[dict] = []
+        clean = True
+        end = offset
+        try:
+            f = open(path, "rb")
+        except FileNotFoundError:
+            return [], (0 if offset == 0 else None), (0 if offset == 0 else None)
+        with f:
+            if offset:
+                f.seek(offset)
+            for raw in f:
+                terminated = raw.endswith(b"\n")
+                if not raw.strip():
+                    if clean and terminated:
+                        end += len(raw)
+                    else:
+                        clean = False
+                    continue
+                try:
+                    obj = json.loads(raw)
+                except json.JSONDecodeError:
+                    # torn (crash-mid-append) bytes: never acked, never
+                    # followed — and never COUNTED (see tail_follow)
+                    clean = False
+                    continue
+                objs.append(obj)
+                if clean and terminated:
+                    end += len(raw)
+                else:
+                    clean = False
+            if not clean:
+                return objs, None, None
+            start = max(0, end - _ColumnarEvents._CRC_WINDOW)
+            f.seek(start)
+            crc = zlib.crc32(f.read(end - start))
+        return objs, end, crc
+
+    def _tail_delta(self, d: str, cursor: dict) -> dict | None:
+        """O(delta) same-generation tail read: seek straight to the
+        cursor's ``tail_bytes`` offset instead of re-reading the whole
+        tail. Returns None (caller falls back to the full decodable-line
+        scan) unless every validation holds: the offset is within the
+        file, lands on a line boundary, and the checksummed trailing
+        bytes of the consumed prefix are byte-identical — so a recovery
+        trim or out-of-band rewrite can never silently shift events
+        under the watermark."""
+        path = os.path.join(d, "tail.jsonl")
+        offset = cursor.get("tail_bytes")
+        if not isinstance(offset, int) or isinstance(offset, bool) or offset < 0:
+            return None
+        try:
+            size = os.path.getsize(path)
+        except OSError:
+            size = 0
+        if size < offset:
+            return None
+        if offset > 0:
+            with open(path, "rb") as f:
+                f.seek(offset - 1)
+                if f.read(1) != b"\n":
+                    return None
+                expect = cursor.get("tail_crc")
+                if isinstance(expect, int) and not isinstance(expect, bool):
+                    start = max(0, offset - self._CRC_WINDOW)
+                    f.seek(start)
+                    if zlib.crc32(f.read(offset - start)) != expect:
+                        return None
+        objs, end, crc = self._scan_tail_bytes(path, offset)
+        return {"objs": objs, "end": end, "crc": crc}
+
     def tail_follow(
         self,
         app_id: int,
@@ -734,8 +823,15 @@ class _ColumnarEvents(LEvents):
         appended since ``cursor`` and the advanced cursor.
 
         The cursor records ``(stream_id, compactions, consumed segment
-        names, consumed tail line count, recent tail ids)``. Three store
-        mutations are survived without loss or duplication:
+        names, consumed tail line count, recent tail ids)`` plus — when
+        the consumed prefix ended cleanly — a ``tail_bytes`` byte offset
+        and a ``tail_crc`` checksum of its trailing bytes, so a
+        same-generation poll seeks straight to the delta instead of
+        re-reading (and re-decoding) the whole tail: poll cost is
+        O(bytes appended since the last poll), not O(tail). Offset
+        mismatch, checksum drift, or any torn bytes fall back to the
+        decodable-line-count scan, which stays the semantic authority.
+        Three store mutations are survived without loss or duplication:
 
         * **segment roll** — bulk writes land whole new (positional-id)
           segments; any segment name not in the cursor is new and read in
@@ -756,38 +852,56 @@ class _ColumnarEvents(LEvents):
         Tombstoned events are filtered like every other scan. The caller
         owns cursor persistence (see ``TailFollower.commit``)."""
         d = self._ensure_stream(app_id, channel_id)
+        tail_path = os.path.join(d, "tail.jsonl")
         with self._lock:
             self._recover(d)
             seg_paths = self._segment_paths(d)
-            try:
-                with open(os.path.join(d, "tail.jsonl")) as f:
-                    lines = [ln for ln in f if ln.strip()]
-            except FileNotFoundError:
-                lines = []
             tomb = self._tombstones(d)
             compactions = self._compactions(d)
             stream_id = self._stream_id(d)
+            fresh = (
+                cursor is None
+                or not cursor.get("stream_id")
+                or cursor.get("stream_id") != stream_id
+            )
+            same_gen = (
+                not fresh
+                and cursor is not None
+                and int(cursor.get("compactions", 0)) == compactions
+            )
+            # O(delta) fast path: a same-generation cursor carrying a
+            # validated byte offset reads only what was appended since
+            # the last poll. Any mismatch (compaction reset the tail,
+            # recovery trimmed torn bytes, checksum drift) returns None
+            # and the decodable-line-count scan below stays the
+            # authority — the cursor semantics never change, only the
+            # bytes read.
+            delta = self._tail_delta(d, cursor) if same_gen else None
+            if delta is None:
+                # torn (crash-mid-append) bytes are never COUNTED: the
+                # cursor indexes DECODABLE lines only, so the recovery
+                # sweep's trim (which rewrites the tail without the torn
+                # bytes) cannot shift consumed indices under a live
+                # watermark and skip the next appended event.
+                tail_objs, tail_end, tail_crc = self._scan_tail_bytes(
+                    tail_path, 0
+                )
+                base_count = 0
+            else:
+                tail_objs = delta["objs"]
+                tail_end = delta["end"]
+                tail_crc = delta["crc"]
+                base_count = int(cursor.get("tail_lines", 0))
         tail_tomb, seg_tomb = self._split_tombstones(tomb)
         names = [os.path.splitext(os.path.basename(p))[0] for p in seg_paths]
 
-        tail_objs: list[dict] = []
-        for ln in lines:
-            try:
-                tail_objs.append(json.loads(ln))
-            except json.JSONDecodeError:
-                # torn (crash-mid-append) bytes: never acked, never
-                # followed — and never COUNTED. The cursor indexes
-                # DECODABLE lines only, so the recovery sweep's trim
-                # (which rewrites the tail without the torn bytes)
-                # cannot shift consumed indices under a live watermark
-                # and skip the next appended event.
-                continue
+        def cursor_tail_fields(count: int) -> dict:
+            out = {"tail_lines": count}
+            if tail_end is not None:
+                out["tail_bytes"] = tail_end
+                out["tail_crc"] = tail_crc
+            return out
 
-        fresh = (
-            cursor is None
-            or not cursor.get("stream_id")
-            or cursor.get("stream_id") != stream_id
-        )
         if fresh and not from_start:
             chain = [
                 i
@@ -798,8 +912,8 @@ class _ColumnarEvents(LEvents):
                 "stream_id": stream_id,
                 "compactions": compactions,
                 "segments": names,
-                "tail_lines": len(tail_objs),
                 "recent_ids": chain[-self._FOLLOW_CHAIN:],
+                **cursor_tail_fields(len(tail_objs)),
             }
         if fresh:
             cursor = {
@@ -812,13 +926,17 @@ class _ColumnarEvents(LEvents):
         assert cursor is not None
         known = set(cursor.get("segments", ()))
         chain = [str(i) for i in cursor.get("recent_ids", ())]
-        same_gen = int(cursor.get("compactions", 0)) == compactions
         new_paths = [p for p, n in zip(seg_paths, names) if n not in known]
         events: list[Event] = []
 
         if same_gen:
             seg_plan = [(p, 0) for p in new_paths]
-            tail_start = min(int(cursor.get("tail_lines", 0)), len(tail_objs))
+            if delta is None:
+                tail_start = min(
+                    int(cursor.get("tail_lines", 0)), len(tail_objs)
+                )
+            else:
+                tail_start = 0  # tail_objs already IS the delta
         else:
             # compaction(s) landed: locate the consumed prefix inside the
             # new explicit-id segments via the newest chain id present
@@ -874,8 +992,8 @@ class _ColumnarEvents(LEvents):
             "stream_id": stream_id,
             "compactions": compactions,
             "segments": names,
-            "tail_lines": len(tail_objs),
             "recent_ids": chain,
+            **cursor_tail_fields(base_count + len(tail_objs)),
         }
 
     def compact(self, app_id: int, channel_id: int | None = None) -> int:

@@ -125,9 +125,23 @@ class _LEventStore:
     """Low-latency reads at serving time (parity: ``LEventStore.scala``).
 
     The reference enforces a blocking timeout around its async storage
-    futures; here reads are local (sqlite/memory) so ``timeout`` is accepted
-    for API parity and ignored.
+    futures. Here ``timeout`` becomes an ambient resilience deadline
+    around the driver scan: local drivers (sqlite/memory/columnar) answer
+    in microseconds and never notice it, but the *remote* storage driver
+    consults :func:`predictionio_tpu.resilience.current_deadline` per RPC
+    attempt — a serving-time read against a slow storage server is cut
+    off at the caller's budget instead of silently ignoring it (piolint
+    PIO208 guards this propagation tree-wide).
     """
+
+    @staticmethod
+    def _scan(timeout: float | None, thunk):
+        if timeout is None:
+            return list(thunk())
+        from predictionio_tpu import resilience
+
+        with resilience.deadline_scope(timeout):
+            return list(thunk())
 
     def find_by_entity(
         self,
@@ -145,8 +159,9 @@ class _LEventStore:
         timeout: float | None = None,
     ) -> list[Event]:
         app_id, channel_id = resolve_app(app_name, channel_name)
-        return list(
-            Storage.get_l_events().find(
+        return self._scan(
+            timeout,
+            lambda: Storage.get_l_events().find(
                 app_id, channel_id,
                 start_time=start_time, until_time=until_time,
                 entity_type=entity_type, entity_id=entity_id,
@@ -154,7 +169,7 @@ class _LEventStore:
                 target_entity_type=target_entity_type,
                 target_entity_id=target_entity_id,
                 limit=limit, reversed=latest,
-            )
+            ),
         )
 
     def find(
@@ -165,7 +180,10 @@ class _LEventStore:
         **filters,
     ) -> list[Event]:
         app_id, channel_id = resolve_app(app_name, channel_name)
-        return list(Storage.get_l_events().find(app_id, channel_id, **filters))
+        return self._scan(
+            timeout,
+            lambda: Storage.get_l_events().find(app_id, channel_id, **filters),
+        )
 
     def aggregate_properties_of_entity(
         self,
@@ -178,6 +196,7 @@ class _LEventStore:
         events = self.find_by_entity(
             app_name, entity_type, entity_id, channel_name,
             event_names=["$set", "$unset", "$delete"], latest=False,
+            timeout=timeout,
         )
         return aggregate_properties_single(events)
 

@@ -163,6 +163,10 @@ class TailFollower:
             "segmentsConsumed": len(cursor.get("segments", ())),
             "segmentsStore": len(state.get("segments", ())),
             "compactions": int(cursor.get("compactions", 0)),
+            # byte offset of the cleanly-consumed tail prefix: polls are
+            # O(delta) while present; absent means the next poll takes
+            # the (line-count) fallback scan (docs/operations.md)
+            "tailBytesConsumed": cursor.get("tail_bytes"),
         }
 
 

@@ -518,6 +518,36 @@ def build_parser() -> argparse.ArgumentParser:
         "existing justifications); add one-line justifications before "
         "committing",
     )
+    lint.add_argument(
+        "--prune-baseline", action="store_true",
+        help="drop baseline entries no current finding matches (fixed "
+        "findings) without accepting anything new; CI fails on stale "
+        "entries, this is the one-command cleanup",
+    )
+
+    # ---- tsan (runtime lock-witness: predictionio_tpu.analysis.witness)
+    tsan = sub.add_parser(
+        "tsan",
+        help="run a pio command under the lock-witness sanitizer: "
+        "records the lock acquisition-order digraph, hold-time "
+        "percentiles and sleeps-under-lock, reports witnessed "
+        "lock-order inversions, and classifies every static PIO207 "
+        "cycle as CONFIRMED or PLAUSIBLE (docs/operations.md)",
+    )
+    tsan.add_argument(
+        "--report", default=None, metavar="FILE",
+        help="also write the JSON report to FILE",
+    )
+    tsan.add_argument(
+        "--long-hold-ms", type=float, default=50.0,
+        help="hold time above which an acquisition counts as a long "
+        "hold (default 50)",
+    )
+    tsan.add_argument(
+        "tsan_args", nargs=argparse.REMAINDER,
+        help="command to run under the witness, e.g. "
+        "`pio tsan -- chaos-ingest --cycles 1`",
+    )
 
     # ---- upgrade (informational parity stub)
     sub.add_parser(
@@ -940,6 +970,7 @@ def main(argv: list[str] | None = None) -> int:
                 root=args.root,
                 baseline_path=args.baseline,
                 update_baseline=args.update_baseline,
+                prune_stale=args.prune_baseline,
             )
             if args.format == "json":
                 print(json.dumps(res.to_json(), indent=2))
@@ -952,14 +983,61 @@ def main(argv: list[str] | None = None) -> int:
                     f"{len(res.baselined)} baselined, "
                     f"{res.suppressed_count} suppressed"
                 )
+                if res.pruned_baseline:
+                    summary += (
+                        f", {res.pruned_baseline} stale baseline entr"
+                        f"{'y' if res.pruned_baseline == 1 else 'ies'} "
+                        "pruned"
+                    )
                 if res.stale_baseline:
                     summary += (
                         f", {res.stale_baseline} stale baseline entr"
                         f"{'y' if res.stale_baseline == 1 else 'ies'} "
-                        "(fixed findings — prune with --update-baseline)"
+                        "(fixed findings — prune with --prune-baseline)"
                     )
                 print(summary)
             return 0 if res.ok else 1
+        elif cmd == "tsan":
+            # run a nested pio command in-process under the lock-witness
+            # sanitizer (stdlib-only; docs/operations.md "Lock-witness
+            # runbook"). The child's locks allocated AFTER install are
+            # recorded; its exit code is combined with the witness
+            # verdict (any witnessed inversion fails the run).
+            from predictionio_tpu.analysis import witness
+
+            cmdline = list(args.tsan_args)
+            if cmdline and cmdline[0] == "--":
+                cmdline = cmdline[1:]
+            if cmdline and cmdline[0] == "pio":
+                cmdline = cmdline[1:]
+            if not cmdline:
+                print("ERROR: pio tsan needs a command to execute, e.g. "
+                      "`pio tsan -- chaos-ingest --cycles 1`",
+                      file=sys.stderr)
+                return 1
+            def run_child() -> int:
+                # a nested command may leave via SystemExit (argparse
+                # errors, server refusals) — fold that into an exit code
+                # so the witness report survives; real witnessed work
+                # already happened by then and must not be discarded
+                try:
+                    return main(cmdline)
+                except SystemExit as e:
+                    code = e.code
+                    if code is None:
+                        return 0
+                    return code if isinstance(code, int) else 1
+
+            child_rc, rep = witness.run_with_witness(
+                run_child, long_hold_ms=args.long_hold_ms
+            )
+            payload = witness.tsan_report(rep)
+            payload["command"] = cmdline
+            payload["exitCode"] = child_rc
+            if args.report:
+                witness.write_report(args.report, payload)
+            print(json.dumps(payload, indent=2, sort_keys=True))
+            return 0 if (payload["ok"] and not child_rc) else 1
         elif cmd == "chaos-ingest":
             # spawns real event-server subprocesses and SIGKILLs them;
             # stdlib-only harness (docs/operations.md "Crash safety")

@@ -34,6 +34,66 @@ import pytest  # noqa: E402
 from predictionio_tpu.data.storage import Storage  # noqa: E402
 
 
+def pytest_addoption(parser):
+    parser.addoption(
+        "--lock-witness",
+        action="store_true",
+        default=False,
+        help="run the suite under the runtime lock-witness sanitizer "
+        "(predictionio_tpu.analysis.witness): records the lock "
+        "acquisition-order digraph and fails loudly on witnessed "
+        "lock-order inversions. Report lands at "
+        "$PIO_LOCK_WITNESS_REPORT (JSON) or the terminal summary.",
+    )
+
+
+def pytest_configure(config):
+    if config.getoption("--lock-witness"):
+        from predictionio_tpu.analysis import witness
+
+        # install BEFORE any test allocates a lock, so every
+        # object constructed during the run is witnessed
+        config._lock_witness = witness.install()
+
+
+def pytest_sessionfinish(session, exitstatus):
+    # "fails loudly": a witnessed lock-order inversion turns a green run
+    # red even though no individual test asserted on it — the sanitizer
+    # is only worth running if its findings gate CI
+    w = getattr(session.config, "_lock_witness", None)
+    if w is not None and exitstatus == 0 and w.report().get("inversions"):
+        session.exitstatus = 3
+
+
+def pytest_unconfigure(config):
+    w = getattr(config, "_lock_witness", None)
+    if w is None:
+        return
+    import json as _json
+
+    from predictionio_tpu.analysis import witness
+
+    witness.uninstall()
+    rep = w.report()
+    payload = witness.tsan_report(rep)
+    path = os.environ.get("PIO_LOCK_WITNESS_REPORT")
+    if path:
+        witness.write_report(path, payload)
+    inv = rep.get("inversions", [])
+    confirmed = [
+        c for c in payload["staticLockCycles"] if c["status"] == "CONFIRMED"
+    ]
+    print(
+        f"\nlock-witness: {len(rep.get('locks', {}))} lock site(s), "
+        f"{len(rep.get('edges', []))} order edge(s), "
+        f"{len(inv)} inversion(s), "
+        f"{len(payload['staticLockCycles'])} static cycle(s) "
+        f"({len(confirmed)} CONFIRMED)"
+    )
+    if inv:
+        print(_json.dumps(inv, indent=2))
+
+
 @pytest.fixture()
 def storage_env(tmp_path):
     """Point the global Storage registry at throwaway in-memory metadata and

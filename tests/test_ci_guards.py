@@ -22,6 +22,7 @@ import json
 import os
 import subprocess
 import sys
+import tempfile
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -368,6 +369,51 @@ def test_online_defaults_are_opt_in():
     assert OnlineConfig().enabled is False
 
 
+def test_lock_witness_over_tier1_concurrency_suites():
+    """Run the two most lock-heavy tier-1 suites (micro-batcher and
+    online learning) under ``pytest --lock-witness`` in a subprocess
+    (ISSUE 8 CI satellite). Doubles as the witness-overhead guard: the
+    un-instrumented suites finish in ~40 s on this host, so the 240 s
+    ceiling fails if the sanitizer's per-acquisition bookkeeping ever
+    regresses to pathological (it is O(held-set) per acquire). Asserts a
+    green exit (the conftest flips exitstatus on witnessed inversions),
+    zero inversions in the JSON report, and that every static PIO207
+    cycle got a CONFIRMED/PLAUSIBLE classification."""
+    report_path = os.path.join(
+        tempfile.mkdtemp(prefix="pio-witness-"), "witness.json"
+    )
+    env = dict(os.environ)
+    env["PIO_LOCK_WITNESS_REPORT"] = report_path
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "pytest",
+            "tests/test_microbatcher.py", "tests/test_online.py",
+            "-q", "--lock-witness",
+        ],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=240,
+        env=env,
+    )
+    assert proc.returncode == 0, (
+        f"tier-1 concurrency suites under --lock-witness rc="
+        f"{proc.returncode}\nstdout tail:\n{proc.stdout[-2000:]}"
+        f"\nstderr tail:\n{proc.stderr[-1000:]}"
+    )
+    with open(report_path, encoding="utf-8") as fh:
+        payload = json.load(fh)
+    wit = payload["witness"]
+    assert wit["locks"], "witness saw no repo lock allocations"
+    assert wit["inversions"] == [], (
+        f"witnessed lock-order inversions in tier-1 suites: "
+        f"{wit['inversions']}"
+    )
+    assert payload["ok"] is True
+    for cyc in payload["staticLockCycles"]:
+        assert cyc["status"] in ("CONFIRMED", "PLAUSIBLE"), cyc
+
+
 def test_bench_smoke_runs_green():
     """Execute the real bench in --smoke mode (tiny shapes, CPU, <60 s
     budget) and validate its one-line JSON contract."""
@@ -545,3 +591,34 @@ def test_bench_smoke_runs_green():
     assert lint["rules"] >= 6
     assert lint["files_scanned"] > 50
     assert lint["new_findings"] == 0, f"non-baselined lint findings: {lint}"
+    assert lint["stale_baseline_entries"] == 0, (
+        f"stale baseline entries shipped: {lint} — run "
+        "`pio lint --prune-baseline`"
+    )
+    # whole-program pass (ISSUE 8): the interprocedural rules only mean
+    # something if the cross-module call graph actually resolved — a
+    # regression that empties it would silently disable PIO206-209
+    cg = lint.get("callgraph")
+    assert cg is not None, "lint section lost its callgraph stats"
+    assert cg["functions"] > 500 and cg["callEdges"] > 500, (
+        f"call graph collapsed — interprocedural rules are blind: {cg}"
+    )
+    assert cg["lockSites"] > 20, f"lock-site discovery collapsed: {cg}"
+    # runtime lock-witness (ISSUE 8): the chaos drill runs under the
+    # sanitizer, so the lint section must carry a witness block with
+    # zero unexplained lock-order inversions, and every static PIO207
+    # cycle classified CONFIRMED or PLAUSIBLE
+    wit = lint.get("witness")
+    assert wit is not None, (
+        "lint section has no witness block — the chaos drill no longer "
+        "runs under the lock-witness sanitizer"
+    )
+    assert wit["lock_sites"] > 0, f"witness saw no repo locks: {wit}"
+    assert wit["inversions"] == [], (
+        f"witnessed lock-order inversions during the chaos drill: "
+        f"{wit['inversions']}"
+    )
+    for cyc in wit["static_cycles"]:
+        assert cyc["status"] in ("CONFIRMED", "PLAUSIBLE"), (
+            f"unclassified static lock cycle: {cyc}"
+        )

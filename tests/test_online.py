@@ -247,6 +247,123 @@ class TestTailFollower:
         with pytest.raises(FollowerUnsupportedError):
             TailFollower("mapp")
 
+    # ---------------------------------------------------- byte-offset cursor
+    def test_poll_reads_o_delta_via_byte_offset(
+        self, columnar_env, tmp_path, monkeypatch
+    ):
+        """ISSUE 8 satellite: a same-generation poll seeks to the
+        persisted ``tail_bytes`` offset and scans ONLY the appended
+        delta — never re-decoding the consumed tail — and the cursor's
+        offset tracks the file size exactly."""
+        from predictionio_tpu.data.storage import columnar as col
+
+        app_id = _new_app(columnar_env, "fapp")
+        le = columnar_env.get_l_events()
+        f = self._follower()
+        f.poll()
+        f.commit()
+        le.insert_batch([_rate(1, i, 3.0, f"base{i}") for i in range(200)], app_id)
+        assert len(f.poll()) == 200
+        f.commit()
+        stream = os.path.join(
+            str(tmp_path / "events"), "pio_events", f"app_{app_id}", "default"
+        )
+        tail = os.path.join(stream, "tail.jsonl")
+        cursor = json.load(open(f._path))
+        assert cursor["tail_bytes"] == os.path.getsize(tail)
+        assert cursor["tail_lines"] == 200
+        assert isinstance(cursor["tail_crc"], int)
+
+        scans = []
+        real_scan = col._ColumnarEvents._scan_tail_bytes
+
+        def spy(path, offset):
+            out = real_scan(path, offset)
+            scans.append((offset, len(out[0])))
+            return out
+
+        monkeypatch.setattr(col._ColumnarEvents, "_scan_tail_bytes", staticmethod(spy))
+        le.insert_batch([_rate(2, 1, 4.0, "d1"), _rate(2, 2, 5.0, "d2")], app_id)
+        assert [e.event_id for e in f.poll()] == ["d1", "d2"]
+        f.commit()
+        # the scan started at the committed offset and decoded only the
+        # two appended lines — O(delta), not O(tail)
+        assert scans, "poll never scanned the tail"
+        offset, n_decoded = scans[-1]
+        assert offset == cursor["tail_bytes"] > 0
+        assert n_decoded == 2
+
+    def test_offset_mismatch_falls_back_to_line_count(
+        self, columnar_env, tmp_path
+    ):
+        """A rewrite that shifts bytes under the persisted offset (the
+        recovery trim's failure mode) is caught — by size, boundary, or
+        checksum — and the poll falls back to the decodable-line-count
+        scan with exactly-once semantics intact."""
+        app_id = _new_app(columnar_env, "fapp")
+        le = columnar_env.get_l_events()
+        f = self._follower()
+        f.poll()
+        f.commit()
+        le.insert_batch(
+            [_rate(1, 1, 3.0, "m1"), _rate(1, 2, 4.0, "m2")], app_id
+        )
+        assert len(f.poll()) == 2
+        f.commit()
+        stream = os.path.join(
+            str(tmp_path / "events"), "pio_events", f"app_{app_id}", "default"
+        )
+        tail = os.path.join(stream, "tail.jsonl")
+        # same length, different bytes inside the CRC window: only the
+        # checksum can catch this
+        raw = open(tail, "rb").read()
+        mutated = raw[:-10] + b"X" * 9 + b"\n"
+        assert len(mutated) == len(raw)
+        open(tail, "wb").write(mutated)
+        # fallback: the mutated final line no longer decodes, so the
+        # line-count scan sees 1 decodable line vs 2 consumed — nothing
+        # is delivered twice and nothing crashes
+        assert f.poll() == []
+        f.commit()
+        le.insert_batch([_rate(1, 3, 5.0, "m3")], app_id)
+        assert [e.event_id for e in f.poll()] == ["m3"]
+        f.commit()
+
+    def test_truncated_tail_falls_back_cleanly(self, columnar_env, tmp_path):
+        """File shorter than the persisted offset (out-of-band trim /
+        reset): the poll must fall back, deliver nothing stale, and
+        resume streaming fresh appends."""
+        app_id = _new_app(columnar_env, "fapp")
+        le = columnar_env.get_l_events()
+        f = self._follower()
+        f.poll()
+        f.commit()
+        le.insert_batch([_rate(1, 1, 3.0, "s1")], app_id)
+        assert len(f.poll()) == 1
+        f.commit()
+        stream = os.path.join(
+            str(tmp_path / "events"), "pio_events", f"app_{app_id}", "default"
+        )
+        open(os.path.join(stream, "tail.jsonl"), "wb").close()  # truncate
+        assert f.poll() == []
+        f.commit()
+        le.insert_batch([_rate(1, 2, 4.0, "s2")], app_id)
+        assert [e.event_id for e in f.poll()] == ["s2"]
+
+    def test_lag_reports_consumed_byte_offset(self, columnar_env):
+        app_id = _new_app(columnar_env, "fapp")
+        le = columnar_env.get_l_events()
+        f = self._follower()
+        f.poll()
+        f.commit()
+        le.insert_batch([_rate(1, 1, 3.0, "g1")], app_id)
+        f.poll()
+        f.commit()
+        lag = f.lag()
+        assert lag["tailLinesConsumed"] == lag["tailLinesStore"]
+        assert isinstance(lag["tailBytesConsumed"], int)
+        assert lag["tailBytesConsumed"] > 0
+
 
 # ---------------------------------------------------------------------------
 # Fold-in solver vs closed form

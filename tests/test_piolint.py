@@ -248,6 +248,38 @@ def test_pio204_thread_daemon_explicit():
     assert _codes("predictionio_tpu/x.py", ok) == []
 
 
+def test_pio204_threadpool_executor_needs_bound():
+    """ISSUE 8 satellite: the rule also covers ThreadPoolExecutor — the
+    default max_workers scales with host cores, so an unbounded pool on
+    a big serving host silently multiplies threads."""
+    bad = """\
+    from concurrent.futures import ThreadPoolExecutor
+    ex = ThreadPoolExecutor()
+    """
+    assert _codes("predictionio_tpu/x.py", bad) == ["PIO204"]
+    # an explicit None is the same unbounded default, spelled out
+    explicit_none = """\
+    import concurrent.futures
+    ex = concurrent.futures.ThreadPoolExecutor(max_workers=None)
+    """
+    assert _codes("predictionio_tpu/x.py", explicit_none) == ["PIO204"]
+    ok_kw = """\
+    from concurrent.futures import ThreadPoolExecutor
+    ex = ThreadPoolExecutor(max_workers=4)
+    """
+    assert _codes("predictionio_tpu/x.py", ok_kw) == []
+    ok_pos = """\
+    from concurrent.futures import ThreadPoolExecutor
+    ex = ThreadPoolExecutor(8)
+    """
+    assert _codes("predictionio_tpu/x.py", ok_pos) == []
+    suppressed = """\
+    from concurrent.futures import ThreadPoolExecutor
+    ex = ThreadPoolExecutor()  # piolint: disable=PIO204
+    """
+    assert _codes("predictionio_tpu/x.py", suppressed) == []
+
+
 _UNBOUNDED_INSTANCE = """\
 class Svc:
     def __init__(self):
@@ -340,6 +372,594 @@ def test_pio205_suppression():
             self._cache[key] = value  # piolint: disable=PIO205
     """
     assert _codes("predictionio_tpu/api/x.py", suppressed) == []
+
+
+# ---------------------------------------------------------------------------
+# PIO206–PIO209: whole-program rules over the cross-module call graph
+# ---------------------------------------------------------------------------
+
+from predictionio_tpu.analysis.engine import lint_sources  # noqa: E402
+
+
+def _program_codes(files: dict) -> list[str]:
+    found, _sup, _stats, _cycles = lint_sources(
+        {p: textwrap.dedent(s) for p, s in files.items()}
+    )
+    return [f.code for f in found]
+
+
+def _program_find(files: dict):
+    found, _sup, _stats, _cycles = lint_sources(
+        {p: textwrap.dedent(s) for p, s in files.items()}
+    )
+    return found
+
+
+_PIO206_CALLER = """\
+import threading
+from predictionio_tpu.helper import slow_helper
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def go(self):
+        with self._lock:
+            slow_helper()
+"""
+
+_PIO206_HELPER = """\
+import time
+
+def slow_helper():
+    deeper()
+
+def deeper():
+    time.sleep(1.0)
+"""
+
+
+def test_pio206_transitive_blocking_under_lock():
+    files = {
+        "predictionio_tpu/caller.py": _PIO206_CALLER,
+        "predictionio_tpu/helper.py": _PIO206_HELPER,
+    }
+    found = _program_find(files)
+    assert [f.code for f in found] == ["PIO206"]
+    f = found[0]
+    assert f.path == "predictionio_tpu/caller.py"
+    assert "time.sleep" in f.message
+    # the chain is shown to humans but is render-only detail: a refactor
+    # that shortens the path must not invalidate the baseline key
+    assert "slow_helper" in f.render() and "deeper" in f.render()
+    assert "slow_helper" not in f.message
+    # remove the lock: the same chain is harmless
+    no_lock = dict(files)
+    no_lock["predictionio_tpu/caller.py"] = _PIO206_CALLER.replace(
+        "with self._lock:\n            slow_helper()",
+        "slow_helper()",
+    )
+    assert _program_codes(no_lock) == []
+    # the DIRECT blocking call under a lock stays PIO202's finding — no
+    # PIO206 double report
+    direct = {
+        "predictionio_tpu/caller.py": """\
+        import threading
+        import time
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def go(self):
+                with self._lock:
+                    time.sleep(1.0)
+        """,
+    }
+    assert _program_codes(direct) == ["PIO202"]
+
+
+def test_pio206_suppression_and_baseline(tmp_path):
+    files = {
+        "predictionio_tpu/caller.py": _PIO206_CALLER.replace(
+            "            slow_helper()",
+            "            slow_helper()  # piolint: disable=PIO206",
+        ),
+        "predictionio_tpu/helper.py": _PIO206_HELPER,
+    }
+    assert _program_codes(files) == []
+    # baseline flavor: the finding is absorbed, a second one is not
+    found = _program_find(
+        {
+            "predictionio_tpu/caller.py": _PIO206_CALLER,
+            "predictionio_tpu/helper.py": _PIO206_HELPER,
+        }
+    )
+    path = str(tmp_path / "baseline.json")
+    write_baseline(found, path)
+    new, old = split_by_baseline(found, load_baseline(path))
+    assert new == [] and len(old) == 1
+
+
+_PIO207_M1 = """\
+import threading
+from predictionio_tpu.m2 import Other
+
+class A:
+    def __init__(self):
+        self._a_lock = threading.Lock()
+        self.other = Other()
+
+    def one(self):
+        with self._a_lock:
+            self.other.poke()
+
+    def fold_hot_rows(self):
+        with self._a_lock:
+            pass
+"""
+
+_PIO207_M2 = """\
+import threading
+
+class Other:
+    def __init__(self, owner=None):
+        self._b_lock = threading.Lock()
+        self.owner = owner  # duck-typed hand-off, untyped on purpose
+
+    def poke(self):
+        with self._b_lock:
+            pass
+
+    def two(self):
+        with self._b_lock:
+            self.owner.fold_hot_rows()
+"""
+
+
+def test_pio207_cross_module_lock_cycle():
+    files = {
+        "predictionio_tpu/m1.py": _PIO207_M1,
+        "predictionio_tpu/m2.py": _PIO207_M2,
+    }
+    found = _program_find(files)
+    assert [f.code for f in found] == ["PIO207"]
+    assert "A._a_lock" in found[0].message
+    assert "Other._b_lock" in found[0].message
+    # consistent order (break the back edge): no cycle
+    consistent = dict(files)
+    consistent["predictionio_tpu/m2.py"] = _PIO207_M2.replace(
+        "        with self._b_lock:\n            self.owner.fold_hot_rows()",
+        "        self.owner.fold_hot_rows()",
+    )
+    assert _program_codes(consistent) == []
+    # a per-module LEXICAL cycle stays PIO203's finding, not PIO207's
+    lexical = {
+        "predictionio_tpu/solo.py": """\
+        import threading
+
+        class C:
+            def __init__(self):
+                self._a_lock = threading.Lock()
+                self._b_lock = threading.Lock()
+
+            def one(self):
+                with self._a_lock:
+                    with self._b_lock:
+                        pass
+
+            def two(self):
+                with self._b_lock:
+                    with self._a_lock:
+                        pass
+        """,
+    }
+    assert _program_codes(lexical) == ["PIO203"]
+
+
+def test_pio207_suppression():
+    files = {
+        "predictionio_tpu/m1.py": _PIO207_M1 + "\n# piolint: disable-file=PIO207\n",
+        "predictionio_tpu/m2.py": _PIO207_M2,
+    }
+    assert _program_codes(files) == []
+
+
+def test_lock_order_cycles_structured_output():
+    """`lock_order_cycles` (shared with `pio tsan`) returns the ring,
+    the provenance edges, and the module span."""
+    from predictionio_tpu.analysis.callgraph import (
+        ProgramContext,
+        build_callgraph,
+    )
+    from predictionio_tpu.analysis.engine import FileContext
+    from predictionio_tpu.analysis.manifest import DEFAULT_MANIFEST
+    from predictionio_tpu.analysis.rules_program import lock_order_cycles
+
+    contexts = {
+        p: FileContext(p, textwrap.dedent(s), DEFAULT_MANIFEST)
+        for p, s in {
+            "predictionio_tpu/m1.py": _PIO207_M1,
+            "predictionio_tpu/m2.py": _PIO207_M2,
+        }.items()
+    }
+    program = ProgramContext(contexts, build_callgraph(contexts))
+    cycles = lock_order_cycles(program)
+    assert len(cycles) == 1
+    cyc = cycles[0]
+    assert cyc["cycle"][0] == cyc["cycle"][-1]
+    assert set(cyc["modules"]) == {
+        "predictionio_tpu/m1.py", "predictionio_tpu/m2.py"
+    }
+    assert not cyc["lexical_only"]
+    kinds = {e["kind"] for e in cyc["edges"]}
+    assert "interproc" in kinds
+
+
+def test_digraph_cycles_enumerates_sibling_cycles():
+    """Regression: a node can sit on several elementary cycles
+    (A->B->C->A and A->C->A share C). The old single-visited-set DFS
+    dropped whichever ring was found second — for PIO207 that silently
+    hid a real cross-module deadlock whenever a sibling ring was
+    enumerated first."""
+    from predictionio_tpu.analysis.callgraph import digraph_cycles
+
+    cycles = digraph_cycles([("A", "B"), ("B", "C"), ("C", "A"), ("A", "C")])
+    assert sorted(cycles) == [["A", "B", "C"], ["A", "C"]]
+    # each ring canonical (smallest node leads) and enumerated once
+    assert digraph_cycles([("A", "B"), ("B", "A")]) == [["A", "B"]]
+    assert digraph_cycles([("A", "B"), ("B", "C")]) == []
+
+
+def test_callgraph_resolution_is_file_order_independent():
+    """Regression: class finalization (bases, attr types) must complete
+    for EVERY file before any file's calls resolve. An alphabetically
+    EARLIER file calling an inherited method of a class defined in a
+    LATER file used to lose the call edge — and with it the PIO206
+    finding — purely because of filename sort order."""
+    caller = """\
+    import threading
+    from predictionio_tpu.z_mod import Svc
+
+    class Driver:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.svc = Svc()
+
+        def go(self):
+            with self._lock:
+                self.svc.fold()
+    """
+    svc = """\
+    import time
+
+    class Base:
+        def fold(self):
+            time.sleep(1.0)
+
+    class Svc(Base):
+        pass
+    """
+    for caller_path in (
+        "predictionio_tpu/a_mod.py",  # caller sorts BEFORE the class file
+        "predictionio_tpu/zz_mod.py",  # and after
+    ):
+        codes = _program_codes(
+            {caller_path: caller, "predictionio_tpu/z_mod.py": svc}
+        )
+        assert "PIO206" in codes, (caller_path, codes)
+
+
+def test_pio206_through_recursive_call_cluster():
+    """Regression: a blocking path that only exists THROUGH a recursive
+    cluster (b -> a -> c -> time.sleep, with a -> b closing the loop)
+    must still be found. The old memoized DFS cached `None` for `b`
+    while `a` was on-stack, permanently hiding the convoy."""
+    files = {
+        "predictionio_tpu/helper.py": """\
+        import time
+
+        def a():
+            b()
+            c()
+
+        def b():
+            a()
+
+        def c():
+            time.sleep(1.0)
+        """,
+        "predictionio_tpu/z.py": """\
+        import threading
+        from predictionio_tpu.helper import b
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def go(self):
+                with self._lock:
+                    b()
+        """,
+    }
+    found = _program_find(files)
+    assert "PIO206" in [f.code for f in found]
+    pio206 = [f for f in found if f.code == "PIO206"]
+    assert pio206[0].path == "predictionio_tpu/z.py"
+    assert "time.sleep" in pio206[0].message
+
+
+_PIO208_DROP = """\
+import urllib.request
+
+def fetch(url, timeout):
+    # the literal per-attempt timeout satisfies PIO401 — but the budget
+    # the CALLER handed in never reaches the wire: that's PIO208
+    return urllib.request.urlopen(url, timeout=30.0).read()
+"""
+
+
+def test_pio208_deadline_not_propagated():
+    assert _program_codes({"predictionio_tpu/n.py": _PIO208_DROP}) == ["PIO208"]
+    # forwarding through the argument (even via a derived local) is fine
+    forwarded = """\
+    import urllib.request
+
+    def fetch(url, timeout):
+        t = min(timeout, 5.0)
+        return urllib.request.urlopen(url, timeout=t).read()
+    """
+    assert _program_codes({"predictionio_tpu/n.py": forwarded}) == []
+    # a poll loop bounded by the budget enforces it around the call
+    loop_bounded = """\
+    import time
+    import urllib.request
+
+    def wait_ready(url, timeout_s):
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < timeout_s:
+            urllib.request.urlopen(url, timeout=1.0)
+    """
+    assert _program_codes({"predictionio_tpu/n.py": loop_bounded}) == []
+    # ambient propagation via `with deadline_scope(deadline):`
+    ambient = """\
+    import urllib.request
+    from predictionio_tpu.resilience import deadline_scope
+
+    def fetch(url, deadline_s):
+        with deadline_scope(deadline_s):
+            return urllib.request.urlopen(url, timeout=1.0).read()
+    """
+    assert _program_codes({"predictionio_tpu/n.py": ambient}) == []
+    # a function with no deadline-ish parameter is out of contract
+    no_param = _PIO208_DROP.replace("def fetch(url, timeout):", "def fetch(url):")
+    assert _program_codes({"predictionio_tpu/n.py": no_param}) == []
+
+
+def test_pio208_internal_callee_with_deadline_param():
+    """The internal half: calling a package function that itself accepts
+    a deadline without passing any budget drops the caller's."""
+    files = {
+        "predictionio_tpu/svc.py": """\
+        from predictionio_tpu.rpc import call_storage
+
+        def handle(query, deadline_s):
+            return call_storage(query)
+        """,
+        "predictionio_tpu/rpc.py": """\
+        def call_storage(query, timeout=30.0):
+            return query
+        """,
+    }
+    found = _program_find(files)
+    assert [f.code for f in found] == ["PIO208"]
+    assert "call_storage" in found[0].message
+    forwarded = dict(files)
+    forwarded["predictionio_tpu/svc.py"] = files[
+        "predictionio_tpu/svc.py"
+    ].replace("call_storage(query)", "call_storage(query, timeout=deadline_s)")
+    assert _program_codes(forwarded) == []
+
+
+def test_pio208_suppression():
+    suppressed = _PIO208_DROP.replace(
+        "    return urllib.request.urlopen(url, timeout=30.0).read()",
+        "    return urllib.request.urlopen(url, timeout=30.0).read()  "
+        "# piolint: disable=PIO208",
+    )
+    assert _program_codes({"predictionio_tpu/n.py": suppressed}) == []
+
+
+_PIO209_ESCAPE = """\
+import threading
+
+def worker(state):
+    state._count += 1
+
+class Owner:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0
+
+    def launch(self):
+        t = threading.Thread(target=worker, args=(self,), daemon=True)
+        t.start()
+        return t
+"""
+
+
+def test_pio209_thread_escape():
+    found = _program_find({"predictionio_tpu/w.py": _PIO209_ESCAPE})
+    assert [f.code for f in found] == ["PIO209"]
+    assert "state._count" in found[0].message
+    assert "Owner" in found[0].message
+    # the worker taking the owning lock is the sanctioned shape
+    guarded = _PIO209_ESCAPE.replace(
+        "def worker(state):\n    state._count += 1",
+        "def worker(state):\n    with state._lock:\n        state._count += 1",
+    )
+    assert _program_codes({"predictionio_tpu/w.py": guarded}) == []
+    # a lock-less class is out of contract (PIO201 parity)
+    lockless = _PIO209_ESCAPE.replace(
+        "        self._lock = threading.Lock()\n", ""
+    )
+    assert _program_codes({"predictionio_tpu/w.py": lockless}) == []
+    # a bound-method target stays PIO201's territory — no double report
+    bound = """\
+    import threading
+
+    class Owner:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._count = 0
+            t = threading.Thread(target=self._run, args=(1,), daemon=True)
+
+        def _run(self, n):
+            self._count += n
+    """
+    assert _program_codes({"predictionio_tpu/w.py": bound}) == ["PIO201"]
+
+
+def test_pio209_suppression_and_baseline(tmp_path):
+    suppressed = _PIO209_ESCAPE.replace(
+        "    state._count += 1",
+        "    state._count += 1  # piolint: disable=PIO209",
+    )
+    assert _program_codes({"predictionio_tpu/w.py": suppressed}) == []
+    found = _program_find({"predictionio_tpu/w.py": _PIO209_ESCAPE})
+    path = str(tmp_path / "baseline.json")
+    write_baseline(found, path)
+    new, old = split_by_baseline(found, load_baseline(path))
+    assert new == [] and len(old) == 1
+
+
+def test_callgraph_resolves_across_modules():
+    """The resolution model the PIO206–209 rules stand on: imports,
+    constructor-typed attributes, annotated parameters, and the
+    unique-method fallback — and its guardrails (foreign constructors
+    and ubiquitous names never resolve)."""
+    from predictionio_tpu.analysis.callgraph import build_callgraph
+    from predictionio_tpu.analysis.engine import FileContext
+    from predictionio_tpu.analysis.manifest import DEFAULT_MANIFEST
+
+    files = {
+        "predictionio_tpu/m1.py": textwrap.dedent(_PIO207_M1),
+        "predictionio_tpu/m2.py": textwrap.dedent(_PIO207_M2),
+        "predictionio_tpu/m3.py": textwrap.dedent(
+            """\
+            import threading
+
+            def free(x):
+                return x
+
+            class User:
+                def __init__(self, helper):
+                    self.helper = helper
+                    self._thread = threading.Thread(target=free, daemon=True)
+
+                def go(self):
+                    free(1)
+                    self._thread.join()  # foreign attr: must NOT resolve
+            """
+        ),
+    }
+    contexts = {
+        p: FileContext(p, s, DEFAULT_MANIFEST) for p, s in files.items()
+    }
+    graph = build_callgraph(contexts)
+    P = "predictionio_tpu"
+    # function + class indexing under module-qualified names
+    assert f"{P}.m1.A.one" in graph.functions
+    assert f"{P}.m2.Other.poke" in graph.functions
+    assert f"{P}.m1.A" in graph.classes
+    # constructor-typed attribute: A.other -> Other
+    assert graph.classes[f"{P}.m1.A"].attr_types["other"] == f"{P}.m2.Other"
+    # lock declarations through the type index
+    assert graph.class_locks(f"{P}.m1.A") == {"_a_lock"}
+    # self.other.poke() resolved cross-module
+    one_callees = {
+        c for s in graph.functions[f"{P}.m1.A.one"].calls for c in s.callees
+    }
+    assert f"{P}.m2.Other.poke" in one_callees
+    # unique-method fallback: self.owner.fold_hot_rows() with the owner
+    # injected untyped
+    two_callees = {
+        c for s in graph.functions[f"{P}.m2.Other.two"].calls for c in s.callees
+    }
+    assert f"{P}.m1.A.fold_hot_rows" in two_callees
+    # guardrails: threading.Thread attr is foreign; .join() resolves to
+    # nothing in-package
+    go_callees = {
+        c for s in graph.functions[f"{P}.m3.User.go"].calls for c in s.callees
+    }
+    assert not any("join" in c for c in go_callees)
+    assert f"{P}.m3.free" in go_callees
+
+
+# ---------------------------------------------------------------------------
+# Baseline pruning (pio lint --prune-baseline)
+# ---------------------------------------------------------------------------
+
+
+def test_prune_baseline_drops_stale_and_caps_counts(tmp_path):
+    from predictionio_tpu.analysis.engine import prune_baseline
+
+    live = _find("predictionio_tpu/x.py", _LOCKED_CLASS)
+    assert len(live) == 1
+    stale = Finding("PIO999", "predictionio_tpu/gone.py", 1, "fixed long ago")
+    path = str(tmp_path / "baseline.json")
+    write_baseline(live + [stale, stale], path)
+    # both keys present: one live, one stale with count 2
+    assert len(load_baseline(path)) == 2
+    pruned = prune_baseline(live, path)
+    assert pruned == 1
+    kept = load_baseline(path)
+    assert len(kept) == 1
+    assert live[0].key() in kept
+    # over-counted live entries are capped at the current occurrence count
+    write_baseline(live + live, path)  # count 2 via duplicated finding
+    data = json.loads(open(path).read())
+    data["entries"][0]["count"] = 5
+    open(path, "w").write(json.dumps({"version": 1, "entries": data["entries"]}))
+    assert prune_baseline(live, path) == 1
+    assert load_baseline(path)[live[0].key()]["count"] == 1
+    # pruning an already-clean baseline is a no-op
+    assert prune_baseline(live, path) == 0
+
+
+def test_pio_lint_prune_baseline_cli(tmp_path):
+    """`pio lint --prune-baseline` drops entries for fixed findings and
+    the rerun stays green with a clean baseline file."""
+    pkg = tmp_path / "predictionio_tpu" / "serving"
+    pkg.mkdir(parents=True)
+    bad = pkg / "bad.py"
+    bad.write_text("import jax\n")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+
+    def lint(*extra):
+        return subprocess.run(
+            [
+                sys.executable, "-m", "predictionio_tpu.tools.console",
+                "lint", "--root", str(tmp_path), *extra,
+            ],
+            capture_output=True, text=True, timeout=120, env=env, cwd=REPO,
+        )
+
+    assert lint("--update-baseline").returncode == 0
+    bad.write_text("import json\n")  # fix the finding -> stale entry
+    proc = lint()
+    assert proc.returncode == 0
+    assert "stale" in proc.stdout
+    proc = lint("--prune-baseline")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "pruned" in proc.stdout
+    data = json.loads((tmp_path / "piolint-baseline.json").read_text())
+    assert data["entries"] == []
+    proc = lint()
+    assert proc.returncode == 0
+    assert "stale" not in proc.stdout
 
 
 # ---------------------------------------------------------------------------
@@ -588,10 +1208,12 @@ def test_pio_lint_cli_exit_codes(tmp_path, fmt):
 
 
 def test_full_tree_lints_clean_and_fast():
-    """The whole repo passes piolint with no non-baselined findings —
-    this is the tier-1 static-analysis gate. AST-only by design: it must
-    finish well inside 10 s on CPU CI with zero imports of the linted
-    modules (no jax init, no storage, no servers)."""
+    """The whole repo passes piolint — per-file rules AND the
+    whole-program PIO206–209 pass over the cross-module call graph —
+    with no non-baselined findings. AST-only by design: zero imports of
+    the linted modules (no jax init, no storage, no servers), and the
+    interprocedural full-tree run must stay inside the 30 s CI budget
+    (ISSUE 8 acceptance)."""
     t0 = time.perf_counter()
     res = run_lint(root=REPO)
     elapsed = time.perf_counter() - t0
@@ -599,7 +1221,21 @@ def test_full_tree_lints_clean_and_fast():
     assert res.ok, "new piolint findings:\n" + "\n".join(
         f.render() for f in res.new_findings
     )
-    assert elapsed < 10.0, f"full-tree lint took {elapsed:.1f}s (AST-only budget is 10s)"
+    # the checked-in baseline must not carry entries for findings that
+    # no longer fire (ISSUE 8 satellite): fix the debt, prune the entry
+    # — `pio lint --prune-baseline` is the one-command cleanup
+    assert res.stale_baseline == 0, (
+        f"{res.stale_baseline} stale piolint-baseline.json entr(y/ies); "
+        "run `pio lint --prune-baseline` and commit"
+    )
+    # the program pass really ran: the call graph covered the tree
+    assert res.callgraph["functions"] > 500
+    assert res.callgraph["classes"] > 100
+    assert res.callgraph["callEdges"] > 500
+    assert res.callgraph["lockSites"] > 50
+    assert elapsed < 30.0, (
+        f"full-tree interprocedural lint took {elapsed:.1f}s (budget 30s)"
+    )
 
 
 def test_deleting_batcher_lock_guard_is_caught():
@@ -659,6 +1295,9 @@ def test_analysis_package_is_stdlib_only():
             sys.executable,
             "-c",
             "import sys; import predictionio_tpu.analysis; "
+            "import predictionio_tpu.analysis.callgraph; "
+            "import predictionio_tpu.analysis.rules_program; "
+            "import predictionio_tpu.analysis.witness; "
             "bad = [m for m in ('jax', 'numpy') if m in sys.modules]; "
             "sys.exit(1 if bad else 0)",
         ],
