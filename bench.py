@@ -1814,6 +1814,149 @@ def _bench_ann_retrieval() -> dict:
     }
 
 
+def _bench_scale_sharded() -> dict:
+    """Sharded factor serving (ISSUE 9): sweep catalog sizes past the
+    single-device budget and prove per-device factor memory scales as
+    ``catalog / model_axis`` while sharded top-K stays tie-stable-
+    identical to the replicated exact path.
+
+    Three parts:
+
+    * the BENCH_r01 OOM shape (``f32[64761856,64]`` vs 17 GB HBM) as a
+      shape-math regression — CPU-safe, nothing allocated: replicated it
+      cannot fit, sharded 8-way it must;
+    * a measured sweep: each point shards real factor tables through the
+      template's ``shard_model_for_serving`` hook, reads back the ACTUAL
+      per-device bytes from the array shards, and asserts
+      ``per_device <= replicated / S * 1.1``;
+    * serving parity + q/s: the same query batch through the pinned
+      replicated exact kernel and the sharded kernel — ids must match
+      exactly (tie-stable), throughput recorded for both (on a CPU host
+      the virtual 8-device mesh shares one socket, so sharded q/s is an
+      overhead measurement here; the memory axis is the product claim).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from predictionio_tpu.data.aggregator import BiMap
+    from predictionio_tpu.ops.als import top_k_items_batch
+    from predictionio_tpu.parallel import sharding
+    from predictionio_tpu.templates.recommendation.engine import (
+        ALSAlgorithm,
+        ALSAlgorithmParams,
+        ALSModel,
+    )
+
+    devices = len(jax.devices())
+    hbm_budget = 17 * 2**30  # the v5e-class budget BENCH_r01 died against
+    oom_rows, oom_rank = 64_761_856, 64
+    repl_bytes = sharding.table_bytes(oom_rows, oom_rank)
+    shard8_bytes = sharding.sharded_table_bytes(oom_rows, oom_rank, 8)
+    out: dict = {
+        "devices": devices,
+        "oom_shape": {
+            "rows": oom_rows,
+            "rank": oom_rank,
+            "replicated_gb": round(repl_bytes / 2**30, 2),
+            "per_device_gb_8way": round(shard8_bytes / 2**30, 3),
+            # one replicated table alone leaves no room for the second
+            # table + workspace inside the budget; its 8-way shard does
+            "replicated_fits_17gb_hbm": 2 * repl_bytes < hbm_budget,
+            "sharded_fits_17gb_hbm": 2 * shard8_bytes < hbm_budget,
+        },
+    }
+    if devices < 2:
+        out["skipped"] = "needs >= 2 devices for a model axis"
+        out["sweep"] = []
+        return out
+
+    sizes = [
+        int(s)
+        for s in os.environ.get(
+            "BENCH_SHARD_ITEMS", "65536,262144,1048576"
+        ).split(",")
+        if s.strip()
+    ]
+    rank = int(os.environ.get("BENCH_SHARD_RANK", 64))
+    n_queries = int(os.environ.get("BENCH_SHARD_QUERIES", 4096))
+    chunk = 512
+    n_queries = max(chunk, n_queries // chunk * chunk)
+    k = 16
+    rng = np.random.default_rng(17)
+    algo = ALSAlgorithm(ALSAlgorithmParams())
+
+    sweep = []
+    for n_items in sizes:
+        n_users = max(1024, n_items // 2)
+        uf = rng.standard_normal((n_users, rank)).astype(np.float32)
+        vf = rng.standard_normal((n_items, rank)).astype(np.float32)
+        # exact score ties must merge identically across layouts
+        vf[1] = vf[0]
+        # the shard hook sizes everything from the factor arrays, so the
+        # id maps can stay empty — building 10^6 string keys would time
+        # the BiMap, not the sharded serving path
+        empty = BiMap.from_dict({})
+        uidx = rng.integers(0, n_users, n_queries).astype(np.int32)
+
+        model_s = ALSModel(uf.copy(), vf.copy(), empty, empty)
+        model_s, bytes_sharded = algo.shard_model_for_serving(model_s)
+        info = model_s._pio_shards
+        S = info.num_shards
+        measured_per_dev = sharding.per_device_bytes(
+            model_s.user_factors
+        ) + sharding.per_device_bytes(model_s.item_factors)
+        repl = uf.nbytes + vf.nbytes
+        per_device_ok = measured_per_dev <= repl / S * 1.1
+
+        def timed(fn) -> tuple[dict, np.ndarray]:
+            np.asarray(fn(uidx[:chunk])[0])  # warm/compile
+            ids_out = []
+            t0 = time.perf_counter()
+            for lo in range(0, n_queries, chunk):
+                ids, _ = fn(uidx[lo : lo + chunk])
+                ids_out.append(np.asarray(ids))
+            wall = time.perf_counter() - t0
+            return (
+                {"queries_per_sec": round(n_queries / wall, 1)},
+                np.concatenate(ids_out, axis=0),
+            )
+
+        shard_stats, shard_ids = timed(
+            lambda q: sharding.sharded_topk_users(
+                q, model_s.user_factors, model_s.item_factors,
+                k, n_items, info.mesh,
+            )
+        )
+
+        uf_d, vf_d = jnp.asarray(uf), jnp.asarray(vf)  # pinned replica
+        repl_stats, repl_ids = timed(
+            lambda q: top_k_items_batch(q, uf_d, vf_d, k)
+        )
+        ids_equal = bool(np.array_equal(shard_ids, repl_ids))
+        del uf_d, vf_d
+
+        sweep.append(
+            {
+                "catalog_items": n_items,
+                "catalog_users": n_users,
+                "rank": rank,
+                "shards": S,
+                "replicated_bytes": int(repl),
+                "sharded_bytes_total": int(bytes_sharded),
+                "measured_per_device_bytes": int(measured_per_dev),
+                "per_device_ok": bool(per_device_ok),
+                "topk_ids_equal": ids_equal,
+                "sharded": shard_stats,
+                "replicated": repl_stats,
+            }
+        )
+        algo.release_pinned_model(model_s)
+    out["queries"] = n_queries
+    out["k"] = k
+    out["sweep"] = sweep
+    return out
+
+
 def _bench_online_freshness() -> dict:
     """Online learning under load (ISSUE 7): steady event ingest while
     clients query, with and without the ``--online`` fold-in daemon in
@@ -2170,6 +2313,16 @@ def _bench_lint() -> dict:
 
 
 def main() -> None:
+    # the scale_sharded section needs a model axis; on a CPU host the
+    # backend exposes one device unless this flag lands BEFORE the first
+    # backend init (below at jax.devices()). Harmless elsewhere: it only
+    # affects the host (cpu) platform, never TPU/GPU device counts.
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+
     import jax
 
     if "--smoke" in sys.argv:
@@ -2229,6 +2382,14 @@ def main() -> None:
         os.environ["BENCH_ANN_ITEMS"] = "16384,262144"
         os.environ["BENCH_ANN_QUERIES"] = "2048"
         os.environ["BENCH_ANN_NPROBE"] = "4"
+        # sharded-serving scale: small shapes, but the larger point's
+        # replicated tables (24 MB) vs per-device shard (3 MB) already
+        # exercises the whole memory-assertion path on the 8-way host
+        # mesh
+        os.environ["BENCH_SHARD"] = "1"
+        os.environ["BENCH_SHARD_ITEMS"] = "16384,131072"
+        os.environ["BENCH_SHARD_RANK"] = "32"
+        os.environ["BENCH_SHARD_QUERIES"] = "1024"
         os.environ.pop("BENCH_PRECISION_COMPARE", None)
         # fresh compile cache: a persistent cache populated on a different
         # host can carry AOT results whose CPU features mismatch (SIGILL risk)
@@ -2341,6 +2502,12 @@ def main() -> None:
             detail["ann_retrieval"] = _bench_ann_retrieval()
         except Exception as e:
             detail["ann_retrieval"] = {"error": str(e)[:300]}
+
+    if os.environ.get("BENCH_SHARD", "1") != "0":
+        try:
+            detail["scale_sharded"] = _bench_scale_sharded()
+        except Exception as e:
+            detail["scale_sharded"] = {"error": str(e)[:300]}
 
     if os.environ.get("BENCH_ONLINE", "1") != "0":
         try:

@@ -114,6 +114,20 @@ DEFAULT_MANIFEST: Manifest = (
         "must declare daemon= explicitly (PIO204 covers the whole tree)",
     ),
     PackageRule(
+        package="predictionio_tpu/parallel",
+        forbid=(
+            "predictionio_tpu.templates",
+            "predictionio_tpu.tools",
+            "predictionio_tpu.serving",
+            "predictionio_tpu.api",
+        ),
+        reason="the distribution layer (meshes, collectives, sharded "
+        "serving kernels) sits beside ops/ at the device level: jax is "
+        "its whole point, but engine templates, CLI tools, and the "
+        "jax-free serving/api packages all sit ABOVE it and import it "
+        "lazily — never the reverse",
+    ),
+    PackageRule(
         package="predictionio_tpu/templates",
         sibling_isolation=True,
         allow=("serving_util", "columnar_util", "results"),

@@ -23,6 +23,11 @@ property checkable here:
 * ``PIO303`` unhashable static arg spec: ``static_argnums``/
   ``static_argnames`` given a list/set/dict literal — jit requires
   hashable statics; pass a tuple.
+* ``PIO304`` raw ``shard_map`` outside ``ops/compat.py``: the shim
+  there absorbs the API's home moves (``jax.experimental.shard_map`` ->
+  ``jax.shard_map``) AND its replication-check rename (``check_rep`` ->
+  ``check_vma``), so a direct import/attribute use in a kernel quietly
+  re-breaks jax<0.6 hosts the moment it needs either knob.
 """
 
 from __future__ import annotations
@@ -82,6 +87,49 @@ def _param_names(fn: ast.FunctionDef) -> set[str]:
     return set(names)
 
 
+def _static_param_names(ctx: FileContext, fn: ast.FunctionDef) -> set[str]:
+    """Parameters declared STATIC by the jit decorator — these are plain
+    Python values, never tracers, so host conversions on them are fine
+    (``int(k)`` on a ``static_argnames`` arg is the idiom for shape
+    math, not a host sync)."""
+    a = fn.args
+    positional = [p.arg for p in a.posonlyargs + a.args]
+    out: set[str] = set()
+    for dec in fn.decorator_list:
+        if not (isinstance(dec, ast.Call) and _is_jit_expr(ctx, dec)):
+            continue
+        for kw in dec.keywords:
+            if kw.arg == "static_argnames" and isinstance(
+                kw.value, (ast.Tuple, ast.List, ast.Set)
+            ):
+                out.update(
+                    e.value
+                    for e in kw.value.elts
+                    if isinstance(e, ast.Constant) and isinstance(e.value, str)
+                )
+            elif kw.arg == "static_argnames" and isinstance(
+                kw.value, ast.Constant
+            ) and isinstance(kw.value.value, str):
+                out.add(kw.value.value)
+            elif kw.arg == "static_argnums":
+                nums = []
+                if isinstance(kw.value, (ast.Tuple, ast.List)):
+                    nums = [
+                        e.value
+                        for e in kw.value.elts
+                        if isinstance(e, ast.Constant)
+                        and isinstance(e.value, int)
+                    ]
+                elif isinstance(kw.value, ast.Constant) and isinstance(
+                    kw.value.value, int
+                ):
+                    nums = [kw.value.value]
+                out.update(
+                    positional[n] for n in nums if 0 <= n < len(positional)
+                )
+    return out
+
+
 @rule(
     "PIO301",
     "host-sync-in-jit",
@@ -91,7 +139,7 @@ def check_host_sync(ctx: FileContext) -> Iterator[Finding]:
     if not _in_scope(ctx):
         return
     for fn in _jitted_functions(ctx):
-        params = _param_names(fn)
+        params = _param_names(fn) - _static_param_names(ctx, fn)
         for node in ast.walk(fn):
             if not isinstance(node, ast.Call):
                 continue
@@ -225,3 +273,50 @@ def check_static_args(ctx: FileContext) -> Iterator[Finding]:
                     f"{type(kw.value).__name__.lower()} literal "
                     "(jit raises at call time, or retraces per call)",
                 )
+
+
+#: the one module allowed to touch jax's shard_map API directly — the
+#: version shim every sharded kernel must import from
+_SHARD_MAP_SHIM = "predictionio_tpu/ops/compat.py"
+
+_SHARD_MAP_ATTRS = frozenset(
+    {"jax.shard_map", "jax.experimental.shard_map.shard_map"}
+)
+
+
+@rule(
+    "PIO304",
+    "raw-shard-map",
+    "shard_map imported/used directly instead of the ops.compat shim",
+)
+def check_raw_shard_map(ctx: FileContext) -> Iterator[Finding]:
+    if not _in_scope(ctx):
+        return
+    if ctx.rel_path.replace("\\", "/") == _SHARD_MAP_SHIM:
+        return
+    seen: set[int] = set()
+    for node in ast.walk(ctx.tree):
+        hit = None
+        if isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            if mod == "jax.experimental.shard_map" or (
+                mod in ("jax", "jax.experimental")
+                and any(a.name == "shard_map" for a in node.names)
+            ):
+                hit = f"from {mod} import shard_map"
+        elif isinstance(node, ast.Import):
+            if any(a.name == "jax.experimental.shard_map" for a in node.names):
+                hit = "import jax.experimental.shard_map"
+        elif isinstance(node, ast.Attribute):
+            if ctx.dotted_name(node) in _SHARD_MAP_ATTRS:
+                hit = ctx.dotted_name(node)
+        if hit is not None and node.lineno not in seen:
+            seen.add(node.lineno)
+            yield ctx.finding(
+                "PIO304",
+                node,
+                f"{hit}: sharded kernels must go through "
+                "predictionio_tpu.ops.compat.shard_map — the shim keeps "
+                "jax<0.6 hosts working (import home + check_rep/"
+                "check_vma rename both live there)",
+            )

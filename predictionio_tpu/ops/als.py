@@ -1473,13 +1473,26 @@ def train_als(
         manager.wait()
         manager.close()
     if mesh is not None:
-        # replicate before stripping the sentinel row: callers consume the
-        # factors as plain (host) arrays, and slicing a model-sharded table
-        # would otherwise need an ambiguous-sharding gather. A jitted
-        # identity performs the reshard on any topology (device_put cannot
-        # retarget a multi-process mesh).
-        replicated = NamedSharding(mesh, PartitionSpec())
-        uf, vf = jax.jit(lambda a, b: (a, b), out_shardings=replicated)(uf, vf)
+        if jax.process_count() > 1:
+            # multi-host: replicate before stripping the sentinel row —
+            # np.asarray cannot assemble a non-fully-addressable array,
+            # and a jitted identity reshards on any topology (device_put
+            # cannot retarget a multi-process mesh)
+            replicated = NamedSharding(mesh, PartitionSpec())
+            uf, vf = jax.jit(
+                lambda a, b: (a, b), out_shardings=replicated
+            )(uf, vf)
+        else:
+            # single-host mesh: assemble the tables on HOST straight
+            # from the per-device shards. The previous jitted replicate
+            # materialized the FULL table on every device right at the
+            # finish line — the one step whose peak per-device memory
+            # was O(catalog) instead of O(catalog / model_axis), which
+            # re-created the BENCH_r01 OOM the sharded sweep avoids.
+            return ALSFactors(
+                user=np.asarray(uf)[:num_users],
+                item=np.asarray(vf)[:num_items],
+            )
     return ALSFactors(user=uf[:num_users], item=vf[:num_items])
 
 

@@ -32,9 +32,34 @@ __all__ = [
 ]
 
 try:  # jax >= 0.5 (and late 0.4.x nightlies)
-    from jax import shard_map  # type: ignore[attr-defined]
+    from jax import shard_map as _jax_shard_map  # type: ignore[attr-defined]
 except ImportError:  # older jax: the experimental home
-    from jax.experimental.shard_map import shard_map  # noqa: F401
+    from jax.experimental.shard_map import shard_map as _jax_shard_map
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_rep: bool = True):
+    """``jax.shard_map`` with a version-portable replication-check knob.
+
+    Kernels whose replicated outputs the static checker cannot infer
+    (``all_gather`` -> ``top_k`` merge chains, e.g. the sharded serving
+    top-K) pass ``check_rep=False``. The flag moved homes across jax
+    releases — ``check_rep`` in the experimental API, ``check_vma`` in
+    the new top-level one — so the translation lives here, beside the
+    import-home shim, instead of in every call site."""
+    if check_rep:
+        return _jax_shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs
+        )
+    try:
+        return _jax_shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=False,
+        )
+    except TypeError:  # jax >= 0.7 renamed the knob
+        return _jax_shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=False,
+        )
 
 try:  # jax >= 0.6 explicit-sharding API
     from jax.sharding import reshard  # type: ignore[attr-defined]

@@ -64,6 +64,7 @@ __all__ = [
     "ivf_topk_users",
     "query_topk",
     "auto_nlist",
+    "shard_runtime",
 ]
 
 #: id-capacity rounding for incrementally grown indexes: ``num_items``
@@ -492,6 +493,81 @@ def update_ivf(
 
 
 # ---------------------------------------------------------------------------
+# Sharded slabs: the --shard-factors composition (ROADMAP item-2 follow-up)
+# ---------------------------------------------------------------------------
+
+
+def _shard_index(index: IVFIndex, mesh) -> IVFIndex:
+    """Lay an index's cluster-major slabs out sharded over the mesh's
+    ``model`` axis: ``nlist`` pads to a multiple of the axis (sentinel
+    slab ids, zero slabs — the sharded kernel masks padded clusters out
+    of stage 1 by the TRUE ``nlist`` in the static metadata), slabs and
+    slab ids shard cluster-major, centroids stay replicated (tiny).
+    Per-device slab memory drops to ``nlist/S · W · K``."""
+    from predictionio_tpu.parallel import sharding  # lazy: avoids a cycle
+
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    S = int(mesh.shape[sharding.MODEL_AXIS])
+    nlist_pad = -(-index.nlist // S) * S
+    pad = nlist_pad - index.nlist
+    cents = np.asarray(index.centroids, np.float32)
+    slabs = np.asarray(index.slabs, np.float32)
+    ids = np.asarray(index.slab_ids, np.int32)
+    if pad:
+        cents = np.concatenate(
+            [cents, np.zeros((pad, cents.shape[1]), np.float32)]
+        )
+        slabs = np.concatenate(
+            [slabs, np.zeros((pad,) + slabs.shape[1:], np.float32)]
+        )
+        ids = np.concatenate(
+            [ids, np.full((pad, ids.shape[1]), index.num_items, np.int32)]
+        )
+    ax = sharding.MODEL_AXIS
+    return IVFIndex(
+        centroids=jnp.asarray(cents),
+        slabs=jax.device_put(
+            slabs, NamedSharding(mesh, PartitionSpec(ax, None, None))
+        ),
+        slab_ids=jax.device_put(
+            ids, NamedSharding(mesh, PartitionSpec(ax, None))
+        ),
+        num_items=index.num_items,
+        nlist=index.nlist,
+        slab_width=index.slab_width,
+    )
+
+
+def shard_runtime(runtime: "AnnRuntime", mesh) -> dict:
+    """Re-lay a runtime's index sharded over the serving mesh (``pio
+    deploy --shard-factors --ann``). The UNPADDED index is kept on the
+    runtime as ``host_index`` so incremental fold-ins
+    (:meth:`AnnRuntime.update_items`) keep operating on the clean id
+    space and re-shard only the updated layout; queries route through
+    :func:`predictionio_tpu.parallel.sharding.sharded_ivf_topk` once
+    ``shard_mesh`` is set. Returns the info-dict delta for
+    ``/stats.json``."""
+    with runtime._lock:
+        index = runtime.index
+    sharded = _shard_index(index, mesh)
+    S = int(mesh.shape["model"])
+    delta = {
+        "shards": S,
+        "bytesIndexPerDevice": int(
+            sharded.centroids.size * 4
+            + (sharded.slabs.size * 4 + sharded.slab_ids.size * 4) // S
+        ),
+    }
+    with runtime._lock:
+        runtime.host_index = index
+        runtime.index = sharded
+        runtime.shard_mesh = mesh
+        runtime.build_info.update(delta)  # /stats.json ann section
+    return delta
+
+
+# ---------------------------------------------------------------------------
 # Query: two-stage jitted retrieval
 # ---------------------------------------------------------------------------
 
@@ -590,6 +666,11 @@ class AnnRuntime:
         self._update_state: dict | None = None
         self.incremental_updates = 0
         self.items_folded = 0
+        #: --shard-factors state (see :func:`shard_runtime`): when set,
+        #: ``index`` holds the PADDED sharded layout queries run on and
+        #: ``host_index`` the unpadded one fold-ins update
+        self.shard_mesh = None
+        self.host_index: IVFIndex | None = None
 
     def update_items(
         self, item_ids: np.ndarray, vectors: np.ndarray, total_items: int
@@ -601,12 +682,23 @@ class AnnRuntime:
         it consistently."""
         with self._lock:
             state = self._update_state
-            index = self.index
+            mesh = self.shard_mesh
+            index = self.host_index if mesh is not None else self.index
         new_index, state, info = update_ivf(
             index, item_ids, vectors, total_items, state
         )
+        # sharded serving: the fold updates the clean unpadded layout,
+        # then the whole (delta-sized rebuilt) layout re-shards — queries
+        # snapshotting the old sharded index finish against it
+        new_sharded = (
+            _shard_index(new_index, mesh) if mesh is not None else None
+        )
         with self._lock:
-            self.index = new_index
+            if mesh is not None:
+                self.host_index = new_index
+                self.index = new_sharded
+            else:
+                self.index = new_index
             self._update_state = state
             self.incremental_updates += 1
             self.items_folded += len(np.asarray(item_ids))
@@ -667,7 +759,14 @@ def query_topk(
         return [], []
     kb = min(index.num_items, max(16, 1 << (k - 1).bit_length()))
     q = jnp.asarray(np.asarray(qvec, dtype=np.float32)[None, :])
-    ids, scores = ivf_topk_batch(q, index, kb, runtime.nprobe)
+    if runtime.shard_mesh is not None:
+        from predictionio_tpu.parallel import sharding
+
+        ids, scores = sharding.sharded_ivf_topk(
+            q, index, kb, runtime.nprobe, runtime.shard_mesh
+        )
+    else:
+        ids, scores = ivf_topk_batch(q, index, kb, runtime.nprobe)
     runtime.note_queries(1)
     ids_l, scores_l = trim_row(
         np.asarray(ids)[0], np.asarray(scores)[0], index.num_items

@@ -36,7 +36,24 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["top_k_scores", "top_k_permuted", "top_k_host"]
+__all__ = ["top_k_scores", "top_k_permuted", "sort_merge_topk", "top_k_host"]
+
+
+def sort_merge_topk(
+    scores: jax.Array, ids: jax.Array, k: int
+) -> tuple[jax.Array, jax.Array]:
+    """Tie-stable top-k of an (unordered) candidate list via ONE two-key
+    ``lax.sort`` on ``(-score, id)`` — exact for ANY id width, at
+    O(n log n) per row. This is :func:`top_k_permuted`'s ``big_ids``
+    branch, shared as the cross-shard candidate reduce of the sharded
+    serving kernels (``parallel/sharding.py``): there the candidate list
+    is only ``S·k`` wide, so the sort is negligible — and the
+    barrier-guarded fast path must not run, because XLA:CPU's
+    TopkDecomposer aborts on a barrier-fed ``top_k`` under manual
+    partitioning (shard_map). Not jitted standalone: it only ever runs
+    inside an already-traced kernel."""
+    neg, sid = jax.lax.sort((-scores, ids), num_keys=2)
+    return sid[..., :k], -neg[..., :k]
 
 
 @functools.partial(jax.jit, static_argnames=("k",))
@@ -86,8 +103,7 @@ def top_k_permuted(
     spacing exceeds 1) keeps exactness through a full two-key sort —
     correct for any id, at the O(n log n) cost."""
     if big_ids:
-        neg, sid = jax.lax.sort((-scores, ids), num_keys=2)
-        return sid[..., :k], -neg[..., :k]
+        return sort_merge_topk(scores, ids, k)
     t, pos = jax.lax.top_k(scores, k)
     # the barrier keeps downstream slices/compares out of the top_k's
     # fusion: XLA:CPU's fast TopK rewrite bails when the sort's results

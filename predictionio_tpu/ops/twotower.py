@@ -393,13 +393,18 @@ def train_two_tower(
         return u, v
 
     t_final = _time.perf_counter()
-    if mesh is not None:
-        # replicate before the host reads the (possibly model-sharded)
-        # tables; slicing off the padding rows happens host-side
+    if mesh is not None and jax.process_count() > 1:
+        # multi-host: replicate before the host reads the (possibly
+        # model-sharded) tables; slicing off padding happens host-side
         u, v = jax.jit(
             _finalize, out_shardings=NamedSharding(mesh, PartitionSpec())
         )(params)
     else:
+        # single host: keep the tables in their (possibly model-sharded)
+        # layout and let np.asarray assemble per-device shards on HOST —
+        # forcing replication here materialized the full tables on every
+        # device at the finish line, the lone O(catalog)-per-device step
+        # of an otherwise O(catalog / model_axis) training run
         u, v = jax.jit(_finalize)(params)
     user_vecs = np.asarray(u)[:num_users]
     item_vecs = np.asarray(v)[:num_items]

@@ -79,6 +79,12 @@ class CacheConfig:
     #: pin model state (factor matrices, jitted score+top-K programs)
     #: device-resident across requests — see workflow/device_state.py
     pin_model: bool = False
+    #: pin factor SHARDS per device instead of a replica (``pio deploy
+    #: --shard-factors``): per-device factor memory drops to
+    #: ``O(table / num_devices)`` so catalogs bigger than one device's
+    #: memory serve; top-K stays tie-stable-identical to the replicated
+    #: exact path (parallel/sharding.py). Implies device residency.
+    shard_factors: bool = False
     #: query field whose value names the per-entity invalidation scope
     #: (``"user"`` for the recommendation templates); None disables
     #: per-scope invalidation (only full flushes apply)
@@ -91,7 +97,12 @@ class CacheConfig:
     @property
     def enabled(self) -> bool:
         """Does any tier change the serving path at all?"""
-        return self.result_cache or self.coalesce or self.pin_model
+        return (
+            self.result_cache
+            or self.coalesce
+            or self.pin_model
+            or self.shard_factors
+        )
 
 
 def canonical_key(body: Any) -> str | None:
@@ -128,6 +139,7 @@ class CacheStats:
         self.entries = 0  # gauge
         self.bytes = 0  # gauge (approximate payload bytes)
         self.bytes_pinned = 0  # gauge: device-resident model state
+        self.factor_shards = 0  # gauge: --shard-factors model-axis size
         self.model_generation = 0  # gauge
 
     def incr(self, name: str, by: int = 1) -> None:
@@ -160,6 +172,7 @@ class CacheStats:
                 "entries": self.entries,
                 "bytes": self.bytes,
                 "bytesPinned": self.bytes_pinned,
+                "factorShards": self.factor_shards,
                 "modelGeneration": self.model_generation,
             }
 

@@ -746,9 +746,60 @@ class ALSAlgorithm(JaxAlgorithm):
         nbytes += int(item.size) * item.dtype.itemsize
         return model, nbytes
 
+    # ------------------------------------------------------ sharded serving
+    def shard_model_for_serving(self, model: ALSModel) -> tuple[ALSModel, int]:
+        """``--shard-factors`` tier (workflow/device_state.py): pin
+        factor SHARDS per device — each of the ``S`` local devices holds
+        a ``[rows/S, K]`` slice of each table instead of a replica, so
+        per-device factor memory is ``O((U+I)·K / S)`` and the largest
+        servable catalog scales with the mesh (the ALX layout training
+        already uses, extended to the query path). Top-K routes through
+        the shard_map kernel in ``parallel/sharding.py``, which is
+        tie-stable-identical to the replicated exact path. Falls back to
+        plain pinning on a single-device host."""
+        from predictionio_tpu.parallel import sharding
+
+        mesh = sharding.serving_mesh()
+        if mesh is None:
+            logging.getLogger(__name__).warning(
+                "--shard-factors requested but only one device is "
+                "visible; falling back to --pin-model replication"
+            )
+            return self.pin_model_for_serving(model)
+        user = sharding.shard_table(np.asarray(model.user_factors), mesh)
+        item = sharding.shard_table(np.asarray(model.item_factors), mesh)
+        info = sharding.ShardInfo(
+            mesh=mesh,
+            rows={
+                "user": int(np.asarray(model.user_factors).shape[0]),
+                "item": int(np.asarray(model.item_factors).shape[0]),
+            },
+        )
+        model.user_factors = user
+        model.item_factors = item
+        model._pio_shards = info
+        model._pio_pinned = True
+        nbytes = int(user.size) * user.dtype.itemsize
+        nbytes += int(item.size) * item.dtype.itemsize
+        return model, nbytes
+
     def release_pinned_model(self, model: ALSModel) -> None:
         """Drop a superseded generation's pinned buffers (hot reload must
-        not accumulate one catalog of device memory per swap)."""
+        not accumulate one catalog of device memory per swap). For a
+        SHARDED generation this must drop every device's shard handles —
+        not just device 0's — so the host-gather strips the even-shard
+        padding and the ShardInfo goes with the buffers."""
+        shards = getattr(model, "_pio_shards", None)
+        if shards is not None:
+            model.user_factors = np.asarray(model.user_factors)[
+                : shards.rows["user"]
+            ]
+            model.item_factors = np.asarray(model.item_factors)[
+                : shards.rows["item"]
+            ]
+            model._pio_shards = None
+            model._pio_pinned = False
+            return
         if getattr(model, "_pio_pinned", False):
             model.user_factors = np.asarray(model.user_factors)
             model.item_factors = np.asarray(model.item_factors)
@@ -764,11 +815,21 @@ class ALSAlgorithm(JaxAlgorithm):
         for ``/stats.json``."""
         from predictionio_tpu.ops import ivf
 
+        shards = getattr(model, "_pio_shards", None)
+        items = np.asarray(model.item_factors)
+        if shards is not None:
+            # sharded tables carry even-shard padding rows — the index
+            # must cluster only the LOGICAL catalog
+            items = items[: shards.rows["item"]]
         index, info = ivf.build_ivf(
-            np.asarray(model.item_factors),
+            items,
             nlist=ann.nlist, seed=ann.seed, iters=ann.kmeans_iters,
         )
         model._pio_ann = ivf.AnnRuntime(index, ann.nprobe, info)
+        if shards is not None:
+            # --shard-factors composition: the cluster-major slabs shard
+            # over the same model axis as the factor tables
+            info = dict(info, **ivf.shard_runtime(model._pio_ann, shards.mesh))
         info = dict(info, algorithm=type(self).__name__,
                     nprobe=model._pio_ann.nprobe)
         return model, info
@@ -978,13 +1039,34 @@ class ALSAlgorithm(JaxAlgorithm):
         if k <= 0:
             return PredictedResult(())
         ann = getattr(model, "_pio_ann", None)
+        shards = getattr(model, "_pio_shards", None)
         if ann is not None:
             from predictionio_tpu.ops import ivf
 
-            ids, scores = ivf.query_topk(
-                ann, np.asarray(model.user_factors[uidx]), k
-            )
+            if shards is not None:
+                from predictionio_tpu.parallel import sharding
+
+                qvec = np.asarray(
+                    sharding.gather_rows(
+                        np.asarray([uidx], np.int32),
+                        model.user_factors, shards.mesh,
+                    )
+                )[0]
+            else:
+                qvec = np.asarray(model.user_factors[uidx])
+            ids, scores = ivf.query_topk(ann, qvec, k)
             pairs = list(zip(ids, scores))
+        elif shards is not None:
+            # sharded exact: one dispatch, each device scores its item
+            # shard, only the S*k finalists cross the interconnect
+            from predictionio_tpu.parallel import sharding
+
+            ids_b, scores_b = sharding.topk_users(
+                shards, model.user_factors, model.item_factors, [uidx], k
+            )
+            pairs = [
+                (int(i), float(s)) for i, s in zip(ids_b[0], scores_b[0])
+            ]
         elif isinstance(model.item_factors, np.ndarray):
             # host path: one GEMV + partial sort, microseconds at catalog
             # sizes below ~10^6 items (shared tie rule: ops/topk.py)
@@ -1051,6 +1133,7 @@ class ALSAlgorithm(JaxAlgorithm):
             model.user_factors, model.item_factors, valid,
             chunk=self.BATCH_PREDICT_CHUNK,
             ann=getattr(model, "_pio_ann", None),
+            shards=getattr(model, "_pio_shards", None),
         )
 
     def batch_predict_json(

@@ -20,7 +20,7 @@ TOPK_CHUNK = 2048
 
 def chunked_topk(
     user_mat, item_mat, valid: Sequence[tuple], chunk: int = TOPK_CHUNK,
-    ann=None,
+    ann=None, shards=None,
 ) -> Iterator[tuple[list, list, list]]:
     """Chunked batch top-k over ``valid = [(slot, uidx, k), ...]``;
     yields ``(part, ids, scores)`` with ids/scores as Python lists — the
@@ -43,10 +43,22 @@ def chunked_topk(
     catalog. Queries whose ``k`` includes a filter over-fetch keep their
     guarantee — the merge returns ``k`` real candidates whenever the
     probed clusters hold that many (sentinel-padded rows are trimmed
-    here, before any consumer sees them)."""
+    here, before any consumer sees them).
+
+    ``shards`` (a :class:`predictionio_tpu.parallel.sharding.ShardInfo`,
+    the ``--shard-factors`` tier) means both tables are model-sharded:
+    the exact path routes through the shard_map kernel (each device
+    scores only its ``[B,K]@[K,I/S]`` slice; tie-stable-identical
+    results), and the ANN path resolves query rows through the sharded
+    gather before the cluster-sharded probe kernel."""
     if not valid:
         return
-    n_items = int(item_mat.shape[0])
+    # under --shard-factors the physical table is padded to a multiple
+    # of the mesh axis; the LOGICAL catalog lives on the ShardInfo
+    n_items = (
+        int(shards.rows["item"]) if shards is not None
+        else int(item_mat.shape[0])
+    )
     k_max = max(k for _, _, k in valid)
     k_max = min(n_items, max(16, 1 << (k_max - 1).bit_length()))
     if ann is not None:
@@ -59,7 +71,16 @@ def chunked_topk(
         for lo in range(0, len(valid), chunk):
             part = list(valid[lo : lo + chunk])
             uidx_arr = np.fromiter((u for _, u, _ in part), np.int32, len(part))
-            if user_on_device:
+            if shards is not None:
+                from predictionio_tpu.parallel import sharding
+
+                padded = np.zeros(chunk, np.int32)
+                padded[: len(part)] = uidx_arr
+                qv = sharding.gather_rows(padded, user_mat, shards.mesh)
+                idx_b, score_b = sharding.sharded_ivf_topk(
+                    qv, ann.index, k_max, ann.nprobe, shards.mesh
+                )
+            elif user_on_device:
                 padded = np.zeros(chunk, np.int32)
                 padded[: len(part)] = uidx_arr
                 idx_b, score_b = ivf.ivf_topk_users(
@@ -105,7 +126,15 @@ def chunked_topk(
     for lo in range(0, len(valid), chunk):
         part = list(valid[lo : lo + chunk])
         uidx_arr = np.fromiter((u for _, u, _ in part), np.int32, len(part))
-        if on_device:
+        if shards is not None:
+            from predictionio_tpu.parallel import sharding
+
+            padded = np.zeros(chunk, np.int32)
+            padded[: len(part)] = uidx_arr
+            idx_b, score_b = sharding.sharded_topk_users(
+                padded, user_mat, item_mat, k_max, n_items, shards.mesh
+            )
+        elif on_device:
             from predictionio_tpu.ops.als import top_k_items_batch
 
             padded = np.zeros(chunk, np.int32)

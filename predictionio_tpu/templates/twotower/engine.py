@@ -388,7 +388,55 @@ class TwoTowerAlgorithm(JaxAlgorithm):
         nbytes += int(item.size) * item.dtype.itemsize
         return model, nbytes
 
+    # ------------------------------------------------------ sharded serving
+    def shard_model_for_serving(
+        self, model: TwoTowerServingModel
+    ) -> tuple[TwoTowerServingModel, int]:
+        """``--shard-factors`` tier: same contract as the recommendation
+        template — tower matrices shard row-wise over a one-axis model
+        mesh (each device holds ``rows/S``), retrieval routes through
+        the tie-stable shard_map kernel, single-device hosts fall back
+        to plain pinning."""
+        from predictionio_tpu.parallel import sharding
+
+        mesh = sharding.serving_mesh()
+        if mesh is None:
+            logging.getLogger(__name__).warning(
+                "--shard-factors requested but only one device is "
+                "visible; falling back to --pin-model replication"
+            )
+            return self.pin_model_for_serving(model)
+        user = sharding.shard_table(np.asarray(model.user_vecs), mesh)
+        item = sharding.shard_table(np.asarray(model.item_vecs), mesh)
+        info = sharding.ShardInfo(
+            mesh=mesh,
+            rows={
+                "user": int(np.asarray(model.user_vecs).shape[0]),
+                "item": int(np.asarray(model.item_vecs).shape[0]),
+            },
+        )
+        model.user_vecs = user
+        model.item_vecs = item
+        model._pio_shards = info
+        model._pio_pinned = True
+        nbytes = int(user.size) * user.dtype.itemsize
+        nbytes += int(item.size) * item.dtype.itemsize
+        return model, nbytes
+
     def release_pinned_model(self, model: TwoTowerServingModel) -> None:
+        shards = getattr(model, "_pio_shards", None)
+        if shards is not None:
+            # every device's shard handles die here, and the host copy
+            # strips the even-shard padding rows
+            model.user_vecs = np.asarray(model.user_vecs)[
+                : shards.rows["user"]
+            ]
+            model.item_vecs = np.asarray(model.item_vecs)[
+                : shards.rows["item"]
+            ]
+            model._pio_shards = None
+            model._pio_pinned = False
+            return
         if getattr(model, "_pio_pinned", False):
             model.user_vecs = np.asarray(model.user_vecs)
             model.item_vecs = np.asarray(model.item_vecs)
@@ -406,11 +454,17 @@ class TwoTowerAlgorithm(JaxAlgorithm):
         the probed clusters do."""
         from predictionio_tpu.ops import ivf
 
+        shards = getattr(model, "_pio_shards", None)
+        items = np.asarray(model.item_vecs)
+        if shards is not None:
+            items = items[: shards.rows["item"]]
         index, info = ivf.build_ivf(
-            np.asarray(model.item_vecs),
+            items,
             nlist=ann.nlist, seed=ann.seed, iters=ann.kmeans_iters,
         )
         model._pio_ann = ivf.AnnRuntime(index, ann.nprobe, info)
+        if shards is not None:
+            info = dict(info, **ivf.shard_runtime(model._pio_ann, shards.mesh))
         info = dict(info, algorithm=type(self).__name__,
                     nprobe=model._pio_ann.nprobe)
         return model, info
@@ -500,6 +554,7 @@ class TwoTowerAlgorithm(JaxAlgorithm):
         for part, idx_l, score_l in chunked_topk(
             model.user_vecs, model.item_vecs, valid,
             ann=getattr(model, "_pio_ann", None),
+            shards=getattr(model, "_pio_shards", None),
         ):
             for (oi, _, k), ids, scs in zip(part, idx_l, score_l):
                 seen = seen_by_slot[oi]
@@ -527,13 +582,30 @@ class TwoTowerAlgorithm(JaxAlgorithm):
         if k <= 0:
             return PredictedResult(())
         ann = getattr(model, "_pio_ann", None)
+        shards = getattr(model, "_pio_shards", None)
         if ann is not None:
             from predictionio_tpu.ops import ivf
 
-            ids, sc = ivf.query_topk(
-                ann, np.asarray(model.user_vecs[uidx]), k
-            )
+            if shards is not None:
+                from predictionio_tpu.parallel import sharding
+
+                qvec = np.asarray(
+                    sharding.gather_rows(
+                        np.asarray([uidx], np.int32),
+                        model.user_vecs, shards.mesh,
+                    )
+                )[0]
+            else:
+                qvec = np.asarray(model.user_vecs[uidx])
+            ids, sc = ivf.query_topk(ann, qvec, k)
             pairs = list(zip(ids, sc))
+        elif shards is not None:
+            from predictionio_tpu.parallel import sharding
+
+            ids_b, sc_b = sharding.topk_users(
+                shards, model.user_vecs, model.item_vecs, [uidx], k
+            )
+            pairs = [(int(i), float(s)) for i, s in zip(ids_b[0], sc_b[0])]
         elif isinstance(model.item_vecs, np.ndarray):
             from predictionio_tpu.ops.topk import top_k_host
 

@@ -235,6 +235,15 @@ def build_parser() -> argparse.ArgumentParser:
         "device-resident across requests (no per-request staging or "
         "re-trace; bytes pinned reported on /stats.json)",
     )
+    deploy.add_argument(
+        "--shard-factors", action="store_true",
+        help="pin factor SHARDS per device instead of a full replica: "
+        "tables split row-wise over a one-axis model mesh of the local "
+        "devices, so per-device factor memory is table/num_devices and "
+        "catalogs bigger than one device's memory serve; exact top-K "
+        "stays tie-stable-identical to the replicated path, and --ann "
+        "slabs shard over the same axis (docs/serving.md)",
+    )
     # ---- approximate retrieval (predictionio_tpu.ops.ivf; docs/serving.md).
     # Strictly opt-in: without --ann every query scores the exact path.
     deploy.add_argument(
@@ -771,7 +780,10 @@ def main(argv: list[str] | None = None) -> int:
                     ),
                 )
             cache = None
-            if args.result_cache or args.coalesce or args.pin_model:
+            if (
+                args.result_cache or args.coalesce or args.pin_model
+                or args.shard_factors
+            ):
                 from predictionio_tpu.serving import CacheConfig
 
                 cache = CacheConfig(
@@ -783,6 +795,7 @@ def main(argv: list[str] | None = None) -> int:
                     ),
                     coalesce=args.coalesce,
                     pin_model=args.pin_model,
+                    shard_factors=args.shard_factors,
                     scope_field=(
                         None
                         if args.cache_scope_field.lower() in ("none", "")

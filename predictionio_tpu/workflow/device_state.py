@@ -47,19 +47,26 @@ __all__ = [
     "append_rows",
     "swap_side_rows",
     "update_ann_items",
+    "shard_count",
 ]
 
 logger = logging.getLogger(__name__)
 
 
-def pin_pairs(pairs: Sequence) -> tuple[list, int]:
+def pin_pairs(pairs: Sequence, shard: bool = False) -> tuple[list, int]:
     """Pin every (algorithm, model) pair that supports it.
 
     Returns ``(pairs, bytes_pinned)`` — the possibly-replaced pair list
     and the total device bytes now held by pinned state (0 when nothing
     opted in or jax is unavailable). Pinning is best-effort: a pair
     whose pin raises is served unpinned rather than failing the load.
-    """
+
+    ``shard=True`` (``pio deploy --shard-factors``) prefers each
+    algorithm's ``shard_model_for_serving`` hook — pin factor SHARDS
+    per device over a one-axis model mesh instead of a full replica, so
+    per-device factor memory is ``O(table / num_devices)`` — falling
+    back to plain pinning when the hook is absent (or the host has one
+    device, where sharding IS replication)."""
     try:
         import jax  # noqa: F401  (availability probe only)
     except Exception:  # pragma: no cover - jax is a hard dep in practice
@@ -69,7 +76,11 @@ def pin_pairs(pairs: Sequence) -> tuple[list, int]:
     out = []
     total = 0
     for algo, model in pairs:
-        pin = getattr(algo, "pin_model_for_serving", None)
+        pin = None
+        if shard:
+            pin = getattr(algo, "shard_model_for_serving", None)
+        if pin is None:
+            pin = getattr(algo, "pin_model_for_serving", None)
         if pin is None:
             out.append((algo, model))
             continue
@@ -78,11 +89,23 @@ def pin_pairs(pairs: Sequence) -> tuple[list, int]:
             total += int(nbytes)
         except Exception:
             logger.exception(
-                "pin_model_for_serving failed for %s; serving unpinned",
+                "%s failed for %s; serving unpinned",
+                getattr(pin, "__name__", "pin_model_for_serving"),
                 type(algo).__name__,
             )
         out.append((algo, model))
     return out, total
+
+
+def shard_count(pairs: Sequence) -> int:
+    """Model-axis size of the sharded serving state (0 when nothing is
+    sharded) — the ``factor_shards`` gauge on ``/stats.json``."""
+    n = 0
+    for _, model in pairs:
+        shards = getattr(model, "_pio_shards", None)
+        if shards is not None:
+            n = max(n, shards.num_shards)
+    return n
 
 
 def build_ann_pairs(pairs: Sequence, ann_config) -> tuple[list, list]:
@@ -144,9 +167,58 @@ def set_rows(mat, idx, rows):
         return out
     import jax.numpy as jnp
 
+    sharded = _named_sharding_of(mat)
+    if sharded is not None:
+        # --shard-factors: route each touched row to the device OWNING
+        # its shard — a jitted scatter whose output sharding is pinned
+        # to the table's own, so the fold's delta crosses the link once
+        # and the table never gathers host-side (the online-compose fix)
+        return _sharded_set_rows(sharded)(
+            mat,
+            jnp.asarray(np.asarray(idx, np.int32)),
+            jnp.asarray(np.asarray(rows), dtype=mat.dtype),
+        )
     return mat.at[jnp.asarray(np.asarray(idx, np.int32))].set(
         jnp.asarray(np.asarray(rows), dtype=mat.dtype)
     )
+
+
+def _named_sharding_of(mat):
+    """The table's NamedSharding when its rows are partitioned over a
+    mesh axis (the --shard-factors layout), else None."""
+    try:
+        from jax.sharding import NamedSharding
+
+        s = getattr(mat, "sharding", None)
+        if (
+            isinstance(s, NamedSharding)
+            and len(s.spec) >= 1
+            and s.spec[0] is not None
+        ):
+            return s
+    except Exception:  # pragma: no cover - very old jax
+        pass
+    return None
+
+
+#: one compiled scatter per distinct table sharding (NamedSharding is
+#: hashable); folds reuse it instead of retracing per call
+_SHARDED_SET_CACHE: dict = {}
+
+
+def _sharded_set_rows(sharding):
+    fn = _SHARDED_SET_CACHE.get(sharding)
+    if fn is None:
+        import jax
+
+        from predictionio_tpu.ops.compat import sharded_scatter_set
+
+        fn = jax.jit(
+            lambda m, i, r: sharded_scatter_set(m, i, r, sharding),
+            out_shardings=sharding,
+        )
+        _SHARDED_SET_CACHE[sharding] = fn
+    return fn
 
 
 def append_rows(mat, rows):
@@ -182,6 +254,15 @@ def swap_side_rows(
     factor table, so a new row must not become rankable before the index
     can translate it back to an item id.
 
+    Under ``--shard-factors`` (``model._pio_shards`` set) the table is
+    padded to a multiple of the mesh axis, so cold-start rows first fill
+    the existing padding slots via the shard-routed scatter; only when
+    the physical capacity is exhausted does the table re-lay-out (host
+    gather + re-shard with ``GROW_STEP`` headroom, so the O(table) cost
+    amortizes over many fold-ins). The logical row count advances on
+    ``ShardInfo.rows`` — kernels mask by it, so a padding slot becomes
+    rankable exactly when its row lands.
+
     Returns ``(rows updated, rows added)``."""
     import numpy as np
 
@@ -205,20 +286,38 @@ def swap_side_rows(
         )
     if new:
         new_ids = [ids[j] for j in new]
+        shards = getattr(model, "_pio_shards", None)
+
+        def grow(mat):
+            if shards is None:
+                return append_rows(mat, rows[new])
+            side = "user" if rows_before_index else "item"
+            logical = int(shards.rows[side])
+            capacity = int(mat.shape[0])
+            if logical + len(new) <= capacity:
+                # scatter into padding slots on their owner shards —
+                # no re-layout, no host round trip of the table
+                out = set_rows(
+                    mat, list(range(logical, logical + len(new))), rows[new]
+                )
+            else:
+                from predictionio_tpu.parallel import sharding
+
+                host = np.asarray(mat)[:logical]
+                out = sharding.shard_table(
+                    np.concatenate([host, rows[new]]),
+                    shards.mesh,
+                    capacity=logical + len(new) + sharding.GROW_STEP,
+                )
+            shards.rows[side] = logical + len(new)
+            return out
+
         if rows_before_index:
-            setattr(
-                model,
-                factors_attr,
-                append_rows(getattr(model, factors_attr), rows[new]),
-            )
+            setattr(model, factors_attr, grow(getattr(model, factors_attr)))
             setattr(model, index_attr, index.extended(new_ids))
         else:
             setattr(model, index_attr, index.extended(new_ids))
-            setattr(
-                model,
-                factors_attr,
-                append_rows(getattr(model, factors_attr), rows[new]),
-            )
+            setattr(model, factors_attr, grow(getattr(model, factors_attr)))
     return len(known), len(new)
 
 

@@ -339,14 +339,24 @@ class QueryService:
             serving, pairs = engine.prepare_deploy(
                 self.ctx, engine_params, instance.id, model.models
             )
-            if self.cache_config is not None and self.cache_config.pin_model:
+            if self.cache_config is not None and (
+                self.cache_config.pin_model or self.cache_config.shard_factors
+            ):
                 # device-resident tier: factor state pinned once per model
                 # generation (lazy boundary — serving/ stays jax-free;
-                # docs/performance.md)
+                # docs/performance.md). --shard-factors pins SHARDS per
+                # device instead of replicas so per-device memory scales
+                # as catalog / num_devices (docs/serving.md).
                 from predictionio_tpu.workflow import device_state
 
-                pairs, bytes_pinned = device_state.pin_pairs(pairs)
+                pairs, bytes_pinned = device_state.pin_pairs(
+                    pairs, shard=self.cache_config.shard_factors
+                )
                 self._cache_stats.set_gauge("bytes_pinned", bytes_pinned)
+                if self.cache_config.shard_factors:
+                    self._cache_stats.set_gauge(
+                        "factor_shards", device_state.shard_count(pairs)
+                    )
             if self.ann_config is not None:
                 # clustered-retrieval tier: IVF index built once per
                 # model generation behind the same lazy jax boundary;
@@ -408,7 +418,13 @@ class QueryService:
             old_pairs
             and old_pairs is not pairs
             and (
-                (self.cache_config is not None and self.cache_config.pin_model)
+                (
+                    self.cache_config is not None
+                    and (
+                        self.cache_config.pin_model
+                        or self.cache_config.shard_factors
+                    )
+                )
                 or self.ann_config is not None
             )
         ):
@@ -800,6 +816,10 @@ class QueryService:
             "feedbackDropped": self.feedback_dropped,
             "batching": self.batcher is not None,
             "caching": self.cache_config is not None,
+            "shardFactors": (
+                self.cache_config is not None
+                and self.cache_config.shard_factors
+            ),
             "ann": self.ann_config is not None,
             "online": self.online is not None,
             # degraded-mode semantics (docs/operations.md): serving the

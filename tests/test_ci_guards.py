@@ -369,6 +369,64 @@ def test_online_defaults_are_opt_in():
     assert OnlineConfig().enabled is False
 
 
+def test_shard_factors_defaults_are_opt_in():
+    """ISSUE 9 guard: sharded factor serving is strictly opt-in. Without
+    ``--shard-factors`` the deploy parser yields no shard flag, an
+    all-default CacheConfig stays disabled, and
+    ``predictionio_tpu.parallel.sharding`` is never imported — the
+    default deploy path stays byte-identical to a build without the
+    module. The piolint manifest must keep the parallel/ layering entry
+    (jax allowed; templates/tools/serving/api forbidden) and the PIO304
+    rule must stay registered so sharded helpers keep going through the
+    ops/compat.py shims."""
+    import inspect
+
+    from predictionio_tpu.serving import CacheConfig
+    from predictionio_tpu.tools.console import build_parser
+
+    args = build_parser().parse_args(["deploy"])
+    assert args.shard_factors is False
+    cfg = CacheConfig()
+    assert cfg.shard_factors is False and cfg.enabled is False
+    assert CacheConfig(shard_factors=True).enabled is True
+    # the pin hook prefers shard_model_for_serving ONLY under shard=True
+    from predictionio_tpu.workflow import device_state
+
+    src = inspect.getsource(device_state.pin_pairs)
+    assert "shard_model_for_serving" in src
+    assert inspect.signature(device_state.pin_pairs).parameters[
+        "shard"
+    ].default is False
+    # default path never imports the sharding module
+    probe = (
+        "import sys; "
+        "import predictionio_tpu.workflow.serving; "
+        "import predictionio_tpu.tools.console; "
+        "import predictionio_tpu.templates.recommendation.engine; "
+        "sys.exit(1 if 'predictionio_tpu.parallel.sharding' in sys.modules "
+        "else 0)"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", probe], cwd=REPO, capture_output=True
+    )
+    assert proc.returncode == 0, proc.stderr.decode()[-500:]
+    # layering: parallel/ declared in the manifest, PIO304 registered
+    from predictionio_tpu.analysis import DEFAULT_MANIFEST, all_rules
+    from predictionio_tpu.analysis.manifest import rules_for
+
+    rules = rules_for(
+        "predictionio_tpu/parallel/sharding.py", DEFAULT_MANIFEST
+    )
+    assert any(
+        "predictionio_tpu.templates" in r.forbid
+        and "predictionio_tpu.tools" in r.forbid
+        for r in rules
+    ), "manifest no longer forbids parallel/ -> templates/tools imports"
+    assert (
+        "PIO304" in all_rules()
+    ), "PIO304 (raw shard_map outside ops/compat.py) fell out of piolint"
+
+
 def test_lock_witness_over_tier1_concurrency_suites():
     """Run the two most lock-heavy tier-1 suites (micro-batcher and
     online learning) under ``pytest --lock-witness`` in a subprocess
@@ -426,8 +484,9 @@ def test_bench_smoke_runs_green():
         cwd=REPO,
         capture_output=True,
         text=True,
-        timeout=420,  # ann_retrieval ~30 s kmeans+scan; online_freshness
-        # adds a train + two 5 s load phases + the incremental-IVF probe
+        timeout=540,  # ann_retrieval ~30 s kmeans+scan; online_freshness
+        # adds a train + two 5 s load phases + the incremental-IVF probe;
+        # scale_sharded adds the 8-way shard sweep (~60 s on a CPU host)
         env=env,
     )
     assert proc.returncode == 0, (
@@ -582,6 +641,31 @@ def test_bench_smoke_runs_green():
         f"incremental IVF drifted from the full rebuild: {inc}"
     )
     assert inc["new_rows"] > 0 and inc["updated_rows"] > 0
+    # sharded-serving scale section (ISSUE 9 acceptance): measured
+    # per-device factor bytes <= replicated/S * 1.1 at every sweep
+    # point, sharded top-K ids tie-stable-identical to the replicated
+    # exact kernel, and the BENCH_r01 OOM shape feasible ONLY sharded
+    sh = detail.get("scale_sharded")
+    assert sh is not None, "missing bench section 'scale_sharded'"
+    assert "error" not in sh, f"scale_sharded errored: {sh}"
+    assert sh["devices"] >= 8, f"no 8-way host mesh in smoke: {sh}"
+    oom = sh["oom_shape"]
+    assert oom["replicated_fits_17gb_hbm"] is False
+    assert oom["sharded_fits_17gb_hbm"] is True
+    assert len(sh["sweep"]) >= 2
+    for point in sh["sweep"]:
+        assert point["catalog_items"] > 0 and point["catalog_users"] > 0
+        assert point["shards"] >= 8
+        assert point["per_device_ok"] is True, (
+            f"per-device factor bytes blew the replicated/S*1.1 budget: "
+            f"{point}"
+        )
+        assert point["topk_ids_equal"] is True, (
+            f"sharded top-K diverged from the replicated exact path: "
+            f"{point}"
+        )
+        assert point["sharded"]["queries_per_sec"] > 0
+        assert point["replicated"]["queries_per_sec"] > 0
     # static-analysis section (ISSUE 3): the bench reports piolint rule
     # and finding counts so the guard output stays machine-checked — a
     # tree with non-baselined findings cannot produce a green smoke

@@ -703,6 +703,109 @@ class TestQueryServiceOnline:
         assert qs.dispatch("POST", "/online/fold.json", {}, None).status == 200
 
 
+@pytest.fixture()
+def sharded_online_service(columnar_env):
+    """Same harness as ``online_service`` but serving under
+    ``--shard-factors --pin-model``: factor tables live as per-device
+    shards across the 8-way host mesh while fold-ins land."""
+    from predictionio_tpu.controller import local_context
+    from predictionio_tpu.online import OnlineConfig
+    from predictionio_tpu.serving import CacheConfig
+    from predictionio_tpu.workflow import load_engine_variant, run_train
+    from predictionio_tpu.workflow.serving import QueryService
+
+    app_id = _new_app(columnar_env, "ols-app")
+    rng = np.random.default_rng(6)
+    columnar_env.get_l_events().insert_batch(
+        [
+            _rate(u, i, (u + i) % 5 + 1)
+            for u, i in zip(rng.integers(0, 30, 600), rng.integers(0, 60, 600))
+        ],
+        app_id,
+    )
+    variant = load_engine_variant(
+        {
+            "id": "ols-eng",
+            "version": "1",
+            "engineFactory": "predictionio_tpu.templates."
+            "recommendation:engine_factory",
+            "datasource": {"params": {"appName": "ols-app"}},
+            "algorithms": [
+                {
+                    "name": "als",
+                    "params": {"rank": 8, "numIterations": 2,
+                               "lambda": 0.05, "seed": 5},
+                }
+            ],
+        }
+    )
+    run_train(variant, local_context())
+    qs = QueryService(
+        variant,
+        cache=CacheConfig(pin_model=True, shard_factors=True),
+        online=OnlineConfig(enabled=True, interval_s=600.0),  # manual folds
+    )
+    yield columnar_env, app_id, qs
+    qs.close()
+
+
+class TestOnlineUnderShardFactors:
+    """ISSUE 9 online-compose satellite: ``apply_online_update`` row
+    scatters must route each touched row to the device OWNING its
+    shard, and cold-start fold-ins must keep the tables sharded."""
+
+    def test_fresh_user_folds_into_sharded_tables(
+        self, sharded_online_service
+    ):
+        from jax.sharding import NamedSharding
+
+        Storage, app_id, qs = sharded_online_service
+        pairs, _ = qs.snapshot_pairs()
+        _algo, model = pairs[0]
+        shards = model._pio_shards
+        assert shards is not None and shards.num_shards == 8
+        assert _query(qs, "fresh-su").body == {"itemScores": []}
+        Storage.get_l_events().insert_batch(
+            [_rate("fresh-su", 1, 5.0, "s1"), _rate("fresh-su", 2, 5.0, "s2")],
+            app_id,
+        )
+        r = qs.dispatch("POST", "/online/fold.json", {}, None)
+        assert r.status == 200
+        scores = _query(qs, "fresh-su").body["itemScores"]
+        assert len(scores) == 4
+        # the table is STILL model-sharded after the fold (the scatter
+        # routed rows to their owner shard instead of gathering host-
+        # side), and the logical row count advanced with the cold start
+        s = model.user_factors.sharding
+        assert isinstance(s, NamedSharding) and s.spec[0] == "model"
+        assert shards.rows["user"] > 30  # trained users + the cold start
+        uidx = model.user_index["fresh-su"]
+        assert uidx < shards.rows["user"]
+        row = np.asarray(model.user_factors)[uidx]
+        assert np.abs(row).sum() > 0  # the solved row actually landed
+
+    def test_known_row_update_lands_on_owner_shard(
+        self, sharded_online_service
+    ):
+        Storage, app_id, qs = sharded_online_service
+        pairs, _ = qs.snapshot_pairs()
+        _algo, model = pairs[0]
+        before = np.asarray(model.user_factors).copy()
+        uidx = model.user_index["3"]
+        Storage.get_l_events().insert_batch(
+            [_rate("3", 7, 5.0, "ks1")], app_id
+        )
+        qs.dispatch("POST", "/online/fold.json", {}, None)
+        after = np.asarray(model.user_factors)
+        assert not np.allclose(before[uidx], after[uidx])
+        # untouched OTHER-shard rows are bit-identical: only the touched
+        # row moved (item side may move too; user table is the probe)
+        untouched = [i for i in range(30) if i != uidx]
+        np.testing.assert_array_equal(
+            before[untouched], after[untouched]
+        )
+
+
 # ---------------------------------------------------------------------------
 # Streaming trainer unit
 # ---------------------------------------------------------------------------

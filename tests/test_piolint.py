@@ -1052,6 +1052,71 @@ def test_pio303_unhashable_static_args():
     assert _codes("predictionio_tpu/ops/x.py", ok) == []
 
 
+def test_pio301_static_args_are_not_traced():
+    """int()/float() on a ``static_argnames``/``static_argnums``
+    parameter is plain Python shape math, never a host sync — the
+    sharded kernels' ``int(k)`` idiom must not fire."""
+    named = """\
+    import functools
+    import jax
+
+    @functools.partial(jax.jit, static_argnames=("k", "mesh"))
+    def f(x, k, mesh):
+        return x[: int(k)]
+    """
+    assert _codes("predictionio_tpu/parallel/x.py", named) == []
+    nums = """\
+    import functools
+    import jax
+
+    @functools.partial(jax.jit, static_argnums=(1,))
+    def f(x, k):
+        return x[: int(k)]
+    """
+    assert _codes("predictionio_tpu/ops/x.py", nums) == []
+    # a NON-static parameter still fires
+    traced = named.replace('("k", "mesh")', '("mesh",)')
+    assert _codes("predictionio_tpu/parallel/x.py", traced) == ["PIO301"]
+
+
+def test_pio304_raw_shard_map():
+    import_from = """\
+    from jax.experimental.shard_map import shard_map
+
+    def f(x):
+        return shard_map(lambda y: y, mesh=None, in_specs=(), out_specs=())(x)
+    """
+    assert _codes("predictionio_tpu/ops/x.py", import_from) == ["PIO304"]
+    assert _codes("predictionio_tpu/parallel/x.py", import_from) == ["PIO304"]
+    attr = """\
+    import jax
+
+    def f(x):
+        return jax.shard_map(lambda y: y, mesh=None, in_specs=(), out_specs=())(x)
+    """
+    found = _find("predictionio_tpu/parallel/x.py", attr)
+    assert [f.code for f in found] == ["PIO304"]
+    assert "ops.compat" in found[0].message
+    # the shim itself is the one legal home
+    assert _codes("predictionio_tpu/ops/compat.py", import_from) == []
+    # host-side packages are out of the jax-hygiene scope
+    assert _codes("predictionio_tpu/workflow/x.py", import_from) == []
+    # the compat-shim import is the sanctioned spelling
+    ok = """\
+    from predictionio_tpu.ops.compat import shard_map
+
+    def f(x):
+        return shard_map(lambda y: y, mesh=None, in_specs=(), out_specs=())(x)
+    """
+    assert _codes("predictionio_tpu/parallel/x.py", ok) == []
+    # inline suppression works like every other rule
+    suppressed = (
+        "from jax.experimental.shard_map import shard_map"
+        "  # piolint: disable=PIO304\n"
+    )
+    assert _codes("predictionio_tpu/ops/x.py", suppressed) == []
+
+
 # ---------------------------------------------------------------------------
 # PIO4xx server hygiene
 # ---------------------------------------------------------------------------
