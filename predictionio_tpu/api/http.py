@@ -62,6 +62,87 @@ def ssl_context_from_env() -> ssl.SSLContext | None:
 #: signature shared with EventService.dispatch / QueryService.dispatch
 Dispatcher = Callable[..., "object"]
 
+
+class _LengthReader:
+    """Bounded raw-body reader (``Content-Length`` requests) handed to
+    streaming routes — ``read(n)`` returns at most ``n`` bytes, ``b""``
+    at end of body."""
+
+    def __init__(self, rfile, length: int):
+        self._r = rfile
+        self._left = max(0, length)
+
+    def read(self, n: int = 65536) -> bytes:
+        if self._left <= 0:
+            return b""
+        data = self._r.read(min(n, self._left))
+        if not data:
+            self._left = 0
+            return b""
+        self._left -= len(data)
+        return data
+
+    @property
+    def exhausted(self) -> bool:
+        return self._left <= 0
+
+
+class _ChunkedReader:
+    """Incremental ``Transfer-Encoding: chunked`` request-body decoder
+    (http.server does not decode chunked uploads itself). Same
+    ``read(n)``/``exhausted`` contract as :class:`_LengthReader`;
+    malformed framing raises ``ValueError`` (the consuming route turns
+    it into a clean stream-level error)."""
+
+    def __init__(self, rfile):
+        self._r = rfile
+        self._left = 0
+        self._done = False
+        self._broken = False
+
+    def _torn(self, what: str) -> ValueError:
+        """Malformed or truncated framing: unknown bytes may remain on
+        the wire — the connection must NOT be reused (exhausted stays
+        False so the handler hangs up) and the consuming route must see
+        an ERROR, never a clean end-of-body (a truncated upload acked
+        ok would silently lose the un-sent half)."""
+        self._done = True
+        self._broken = True
+        return ValueError(what)
+
+    def read(self, n: int = 65536) -> bytes:
+        if self._done:
+            return b""
+        if self._left == 0:
+            line = self._r.readline(1024)
+            if not line:
+                raise self._torn(
+                    "connection closed before the terminating chunk"
+                )
+            try:
+                size = int(line.split(b";")[0].strip() or b"0", 16)
+            except ValueError:
+                raise self._torn(f"bad chunk size line {line[:32]!r}")
+            if size == 0:
+                while True:  # consume optional trailers up to blank line
+                    t = self._r.readline(1024)
+                    if not t or t in (b"\r\n", b"\n"):
+                        break
+                self._done = True
+                return b""
+            self._left = size
+        data = self._r.read(min(n, self._left))
+        if not data:
+            raise self._torn("connection closed mid-chunk")
+        self._left -= len(data)
+        if self._left == 0:
+            self._r.read(2)  # CRLF closing the chunk
+        return data
+
+    @property
+    def exhausted(self) -> bool:
+        return self._done and not self._broken
+
 #: readiness hook: () -> {"ready": bool, "checks": {...}} — served at
 #: GET /readyz (see _make_handler)
 ReadinessHook = Callable[[], Mapping]
@@ -149,6 +230,14 @@ def _make_handler(
             params = {
                 k: v[0] for k, v in urllib.parse.parse_qs(parsed.query).items()
             }
+            # streaming routes (the bulk-ingest endpoint): the service
+            # gets the raw body reader instead of a parsed JSON body, so
+            # the payload is consumed incrementally — never materialized
+            owner = getattr(dispatch, "__self__", None)
+            stream_routes = getattr(owner, "stream_routes", None)
+            if stream_routes and (self.command, parsed.path) in stream_routes:
+                self._dispatch_stream(parsed, params)
+                return
             body = None
             form: Mapping[str, str] | None = None
             length = int(self.headers.get("Content-Length") or 0)
@@ -189,6 +278,75 @@ def _make_handler(
                 getattr(resp, "content_type", "application/json; charset=UTF-8"),
                 getattr(resp, "headers", None),
             )
+
+        def _dispatch_stream(self, parsed, params):
+            te = (self.headers.get("Transfer-Encoding") or "").lower()
+            if "chunked" in te:
+                reader = _ChunkedReader(self.rfile)
+            else:
+                reader = _LengthReader(
+                    self.rfile, int(self.headers.get("Content-Length") or 0)
+                )
+            try:
+                resp = dispatch(
+                    method=self.command,
+                    path=parsed.path,
+                    params=params,
+                    body=None,
+                    headers=dict(self.headers),
+                    form=None,
+                    stream=reader,
+                )
+            except Exception:
+                logger.exception(
+                    "Unhandled error for %s %s", self.command, parsed.path
+                )
+                self._send(500, b'{"message": "Internal Server Error"}')
+                self.close_connection = True
+                return
+            chunks = getattr(resp, "chunks", None)
+            if chunks is None:
+                # plain Response (auth / validation errors before the
+                # body was touched)
+                self._send(
+                    resp.status,
+                    resp.json_bytes(),
+                    getattr(resp, "content_type", "application/json; charset=UTF-8"),
+                    getattr(resp, "headers", None),
+                )
+            else:
+                self._send_stream(resp, chunks)
+            if not reader.exhausted:
+                # unread request bytes would desync a kept-alive
+                # connection — hang up instead
+                self.close_connection = True
+
+        def _send_stream(self, resp, chunks):
+            """Chunked-transfer response: each piece goes out (and is
+            flushed) the moment the service yields it."""
+            self.send_response(resp.status)
+            self.send_header(
+                "Content-Type",
+                getattr(resp, "content_type", "application/x-ndjson"),
+            )
+            self.send_header("Transfer-Encoding", "chunked")
+            for k, v in (getattr(resp, "headers", None) or {}).items():
+                self.send_header(k, v)
+            self.end_headers()
+            try:
+                for piece in chunks:
+                    if not piece:
+                        continue
+                    self.wfile.write(
+                        f"{len(piece):X}\r\n".encode("ascii") + piece + b"\r\n"
+                    )
+                    self.wfile.flush()
+                self.wfile.write(b"0\r\n\r\n")
+            except Exception:
+                # mid-stream failure after a 200 status: the truncated
+                # chunked framing is the client's error signal
+                logger.exception("streaming response aborted")
+                self.close_connection = True
 
         def _ready_probe(self):
             """GET /readyz: 200 when the service's readiness hook says

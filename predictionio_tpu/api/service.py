@@ -48,6 +48,7 @@ from predictionio_tpu.data.storage import Storage
 
 __all__ = [
     "Response",
+    "StreamingResponse",
     "EventService",
     "MAX_BATCH_SIZE",
     "invalidate_access_key_caches",
@@ -89,6 +90,20 @@ class Response:
 
     def json_bytes(self) -> bytes:
         return json.dumps(self.body, default=str).encode()
+
+
+@dataclasses.dataclass
+class StreamingResponse:
+    """A response whose body is produced incrementally (the bulk-ingest
+    route): ``chunks`` yields byte pieces the transport sends with
+    chunked transfer encoding as they become ready — per-chunk ingest
+    statuses stream back while the payload is still arriving, so a
+    100 MB upload never buffers its response."""
+
+    status: int
+    chunks: Any  # Iterator[bytes]
+    headers: Mapping[str, str] | None = None
+    content_type: str = "application/x-ndjson"
 
 
 def _msg(status: int, message: str) -> Response:
@@ -140,6 +155,21 @@ class EventService:
         self._dedup_lock = threading.Lock()
         self._dedup_hits = 0
         self._dedup_misses = 0
+        # streaming bulk-route counters (docs/eventserver.md): updated
+        # per CHUNK by the ingest pipeline, never per event
+        self._bulk_lock = threading.Lock()
+        self._bulk_requests = 0
+        self._bulk_chunks = 0
+        self._bulk_received = 0
+        self._bulk_stored = 0
+        self._bulk_duplicates = 0
+        self._bulk_invalid = 0
+        self._bulk_bytes = 0
+        self._bulk_storage_errors = 0
+        #: optional background compaction scheduler (`pio eventserver
+        #: --compact-interval-s`); surfaced on /stats.json and stopped
+        #: by the drain hook
+        self.compaction_scheduler = None
         with _LIVE_SERVICES_LOCK:
             _LIVE_SERVICES.add(self)
 
@@ -351,6 +381,181 @@ class EventService:
             self._record_stats(access_key.appid, item, entry["status"])
         return Response(200, results)
 
+    # ------------------------------------------------- streaming bulk ingest
+    #: routes the HTTP wrapper hands a raw body STREAM instead of a
+    #: parsed JSON body (chunked transfer + gzip supported) — the
+    #: payload is never materialized whole
+    stream_routes = frozenset({("POST", "/events/bulk.json")})
+
+    #: rows per pipeline chunk (one columnar segment append per chunk);
+    #: ``?chunkRows=`` overrides within [64, 65536]
+    BULK_CHUNK_ROWS = 4096
+
+    def create_events_bulk(
+        self,
+        params: Mapping[str, str],
+        headers: Mapping[str, str] | None = None,
+        stream: Any = None,
+    ) -> Response | StreamingResponse:
+        """``POST /events/bulk.json`` — NDJSON (one event per line),
+        unbounded count, optional ``Content-Encoding: gzip``, chunked
+        transfer welcome. The body flows through the pipelined
+        parse→validate→append stages straight into the event store's
+        columnar bulk path; the response streams one NDJSON status
+        object per ingested chunk (stored/duplicate/invalid counts,
+        per-line error offsets) and a final ``{"done": true}`` summary.
+        Dedup semantics are identical to the single/batch routes:
+        client ``eventId``s are idempotency keys, duplicates answer
+        with per-line offsets instead of storing twice."""
+        auth = self._auth(params, headers)
+        if isinstance(auth, Response):
+            return auth
+        access_key, channel_id = auth
+        if stream is None:
+            return _msg(400, "Bulk route requires a streamed request body.")
+        try:
+            chunk_rows = int(params.get("chunkRows", self.BULK_CHUNK_ROWS))
+        except ValueError:
+            return _msg(400, "chunkRows must be an integer.")
+        chunk_rows = max(64, min(65536, chunk_rows))
+        encoding = ""
+        ctype = ""
+        if headers:
+            for k, v in headers.items():
+                lk = k.lower()
+                if lk == "content-encoding":
+                    encoding = v.lower()
+                elif lk == "content-type":
+                    ctype = v.split(";")[0].strip().lower()
+        if encoding and encoding not in ("gzip", "x-gzip", "identity"):
+            return _msg(415, f"Unsupported Content-Encoding '{encoding}'.")
+        gzipped = encoding in ("gzip", "x-gzip")
+        # two wire formats: NDJSON (one event per line — default) and
+        # the columnar chunk encoding (one pre-columnarized EventChunk
+        # per line) that skips per-event parsing entirely
+        wire = "chunks" if ctype == "application/x-pio-chunks" else "ndjson"
+        return StreamingResponse(
+            200,
+            self._bulk_lines(
+                stream, access_key, channel_id, chunk_rows, gzipped, wire
+            ),
+        )
+
+    def _bulk_lines(
+        self, stream, access_key, channel_id, chunk_rows: int, gzipped: bool,
+        wire: str = "ndjson",
+    ):
+        """Generator driving stage 0 of the pipeline: read byte blocks
+        off the socket (gunzip incrementally), feed the parser, and
+        yield per-chunk status lines as the appender finishes them —
+        socket read, parse, and fsync'd append overlap."""
+        import zlib
+
+        from predictionio_tpu.data.ingest import IngestPipeline, PipelineError
+
+        pipeline = IngestPipeline(
+            Storage.get_l_events(),
+            access_key.appid,
+            channel_id,
+            chunk_rows=chunk_rows,
+            allowed_events=(
+                frozenset(access_key.events) if access_key.events else None
+            ),
+            wire=wire,
+        )
+        decomp = zlib.decompressobj(47) if gzipped else None
+        bytes_in = 0
+        storage_errors = 0
+        dedup_hits = 0
+        dedup_misses = 0
+
+        def encode(result) -> bytes:
+            nonlocal storage_errors, dedup_hits, dedup_misses
+            if result.storage_error is not None:
+                storage_errors += 1
+            dedup_hits += result.dedup_hits
+            dedup_misses += result.dedup_misses
+            return (
+                json.dumps(result.to_json(), separators=(",", ":")) + "\n"
+            ).encode()
+
+        ok = True
+        error: str | None = None
+        try:
+            try:
+                while True:
+                    block = stream.read(65536)
+                    if not block:
+                        break
+                    bytes_in += len(block)
+                    pipeline.feed(
+                        decomp.decompress(block) if decomp else block
+                    )
+                    for result in pipeline.poll():
+                        yield encode(result)
+                if decomp is not None:
+                    tail = decomp.flush()
+                    if tail:
+                        pipeline.feed(tail)
+                    if not decomp.eof:
+                        # zlib only raises on CORRUPT input; a cut-off
+                        # gzip member flushes quietly — acking it would
+                        # silently drop everything after the truncation
+                        raise ValueError("truncated gzip body")
+                for result in pipeline.finish():
+                    yield encode(result)
+            except (PipelineError, zlib.error, OSError, ValueError) as e:
+                logger.exception("bulk ingest stream failed")
+                ok = False
+                error = str(e)[:200]
+                pipeline.close()
+            summary = pipeline.summary()
+            summary["done"] = True
+            summary["ok"] = ok and storage_errors == 0
+            summary["storageErrors"] = storage_errors
+            if error is not None:
+                summary["error"] = error
+            yield (json.dumps(summary, separators=(",", ":")) + "\n").encode()
+        finally:
+            # also runs on GeneratorExit (client hung up mid-stream):
+            # unblock and stop the stage threads instead of leaking them
+            pipeline.close()
+            s = pipeline.summary()
+            with self._bulk_lock:
+                self._bulk_requests += 1
+                self._bulk_chunks += s["chunks"]
+                self._bulk_received += s["received"]
+                self._bulk_stored += s["stored"]
+                self._bulk_duplicates += s["duplicates"]
+                self._bulk_invalid += s["invalid"]
+                self._bulk_bytes += bytes_in
+                self._bulk_storage_errors += storage_errors
+            with self._dedup_lock:
+                self._dedup_hits += dedup_hits
+                self._dedup_misses += dedup_misses
+
+    def bulk_stats(self) -> dict:
+        with self._bulk_lock:
+            return {
+                "requests": self._bulk_requests,
+                "chunks": self._bulk_chunks,
+                "received": self._bulk_received,
+                "stored": self._bulk_stored,
+                "duplicates": self._bulk_duplicates,
+                "invalid": self._bulk_invalid,
+                "bytesIn": self._bulk_bytes,
+                "storageErrors": self._bulk_storage_errors,
+            }
+
+    # ------------------------------------------------------------- lifecycle
+    def drain(self) -> None:
+        """Drain hook (discovered by the HTTP wrapper): stop the
+        background compaction scheduler before the storage flush so a
+        draining server never starts new tail rewrites."""
+        scheduler = self.compaction_scheduler
+        if scheduler is not None:
+            scheduler.stop()
+
     def get_event(
         self, event_id: str, params: Mapping[str, str], headers=None
     ) -> Response:
@@ -425,6 +630,12 @@ class EventService:
         payload = self.stats.to_json()
         payload["accessKeyCache"] = self.key_cache_stats()
         payload["dedup"] = self.dedup_stats()
+        warm = getattr(Storage.get_l_events(), "dedup_warm_stats", None)
+        if callable(warm):
+            payload["dedup"].update(warm())
+        payload["bulk"] = self.bulk_stats()
+        if self.compaction_scheduler is not None:
+            payload["compaction"] = self.compaction_scheduler.to_json()
         return Response(200, payload)
 
     def webhook(
@@ -481,9 +692,12 @@ class EventService:
         body: Any = None,
         headers: Mapping[str, str] | None = None,
         form: Mapping[str, str] | None = None,
-    ) -> Response:
+        stream: Any = None,
+    ) -> Response | StreamingResponse:
         """Route one request (shared by the HTTP wrapper and in-process
-        tests — the spray-testkit analog)."""
+        tests — the spray-testkit analog). ``stream`` carries the raw
+        body reader for :attr:`stream_routes`; every other route keeps
+        the parsed-``body`` contract byte-identical."""
         method = method.upper()
         if path == "/" and method == "GET":
             return self.status()
@@ -494,6 +708,8 @@ class EventService:
                 return self.find_events(params, headers)
         if path == "/batch/events.json" and method == "POST":
             return self.create_events_batch(body, params, headers)
+        if path == "/events/bulk.json" and method == "POST":
+            return self.create_events_bulk(params, headers, stream)
         if path.startswith("/events/") and path.endswith(".json"):
             event_id = path[len("/events/"):-len(".json")]
             if method == "GET":

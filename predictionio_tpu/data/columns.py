@@ -16,10 +16,16 @@ produces it at memcpy speed from its on-disk segments.
 from __future__ import annotations
 
 import dataclasses
+from typing import Any
 
 import numpy as np
 
-__all__ = ["EventColumns", "columns_from_events", "encode_strings"]
+__all__ = [
+    "EventChunk",
+    "EventColumns",
+    "columns_from_events",
+    "encode_strings",
+]
 
 
 @dataclasses.dataclass
@@ -47,6 +53,135 @@ class EventColumns:
 
     def __len__(self) -> int:
         return int(self.event_code.shape[0])
+
+
+@dataclasses.dataclass
+class EventChunk:
+    """One already-extracted batch of the bulk-ingest write path.
+
+    The streaming bulk route and ``pio import`` parse NDJSON lines
+    straight into this shape — python lists for the string fields (they
+    feed ``np.unique`` dictionary encoding once per chunk), numpy arrays
+    for the numeric ones — instead of constructing per-event
+    ``Event``/``DataMap`` objects. Every row carries an ``ids`` entry
+    (client-supplied or generated at parse time), which is what makes a
+    retried bulk stream idempotent end to end. ``propf`` holds the
+    numeric property columns (NaN = absent, ``propint`` remembers int
+    inputs); everything non-numeric rides in the ``extra`` JSON residue
+    (``""`` = none) exactly like the columnar segment layout.
+    """
+
+    event: list  # str per row
+    entity_type: list  # str per row
+    entity_id: list  # str per row
+    target_entity_type: list  # str | None per row
+    target_entity_id: list  # str | None per row
+    t_us: np.ndarray  # int64 [N], UTC microseconds
+    c_us: np.ndarray  # int64 [N]
+    ids: list  # str per row — the dedup keys
+    propf: dict[str, np.ndarray]  # float64 [N], NaN = absent
+    propint: dict[str, np.ndarray]  # bool [N]: value was an int
+    extra: list  # str per row, "" = none (JSON residue)
+
+    def __len__(self) -> int:
+        return len(self.event)
+
+    def to_events(self) -> list:
+        """Decode rows into ``Event`` objects — the universal-driver
+        adapter behind ``LEvents.ingest_chunk``'s base default (sqlite,
+        memory, ...). The columnar driver never calls this."""
+        import datetime as _dt
+        import json as _json
+
+        from predictionio_tpu.data.event import DataMap, Event
+
+        utc = _dt.timezone.utc
+        out = []
+        for i in range(len(self.event)):
+            props: dict[str, Any] = {}
+            for k, col in self.propf.items():
+                v = col[i]
+                if not np.isnan(v):
+                    props[k] = int(v) if self.propint[k][i] else float(v)
+            tags: tuple = ()
+            pr_id = None
+            if self.extra[i]:
+                residue = _json.loads(self.extra[i])
+                props.update(residue.get("p", {}))
+                tags = tuple(residue.get("tags", ()))
+                pr_id = residue.get("prId")
+            out.append(
+                Event(
+                    event=self.event[i],
+                    entity_type=self.entity_type[i],
+                    entity_id=self.entity_id[i],
+                    target_entity_type=self.target_entity_type[i],
+                    target_entity_id=self.target_entity_id[i],
+                    properties=DataMap(props),
+                    event_time=_dt.datetime.fromtimestamp(
+                        int(self.t_us[i]) / 1e6, tz=utc
+                    ),
+                    event_id=self.ids[i],
+                    tags=tags,
+                    pr_id=pr_id,
+                    creation_time=_dt.datetime.fromtimestamp(
+                        int(self.c_us[i]) / 1e6, tz=utc
+                    ),
+                )
+            )
+        return out
+
+    def to_wire(self) -> dict:
+        """JSON-safe encoding for the storage RPC (NaN → null)."""
+        return {
+            "event": list(self.event),
+            "entityType": list(self.entity_type),
+            "entityId": list(self.entity_id),
+            "targetEntityType": list(self.target_entity_type),
+            "targetEntityId": list(self.target_entity_id),
+            "tUs": [int(v) for v in self.t_us],
+            "cUs": [int(v) for v in self.c_us],
+            "ids": list(self.ids),
+            "propf": {
+                k: [None if np.isnan(v) else float(v) for v in col]
+                for k, col in self.propf.items()
+            },
+            "propint": {
+                k: [bool(v) for v in col] for k, col in self.propint.items()
+            },
+            "extra": list(self.extra),
+        }
+
+    @staticmethod
+    def from_wire(obj: dict) -> "EventChunk":
+        propf = {
+            k: np.asarray(
+                [np.nan if v is None else float(v) for v in col], np.float64
+            )
+            for k, col in (obj.get("propf") or {}).items()
+        }
+        propint = {
+            k: np.asarray(col, dtype=bool)
+            for k, col in (obj.get("propint") or {}).items()
+        }
+        return EventChunk(
+            event=[*map(str, obj["event"])],
+            entity_type=[*map(str, obj["entityType"])],
+            entity_id=[*map(str, obj["entityId"])],
+            target_entity_type=[
+                None if v is None else str(v) for v in obj["targetEntityType"]
+            ],
+            target_entity_id=[
+                None if v is None else str(v) for v in obj["targetEntityId"]
+            ],
+            t_us=np.asarray(obj["tUs"], np.int64),
+            c_us=np.asarray(obj["cUs"], np.int64),
+            # null id = "generate one" (parse_chunk_wire stamps it)
+            ids=["" if v is None else str(v) for v in obj["ids"]],
+            propf=propf,
+            propint=propint,
+            extra=[*map(str, obj.get("extra") or [""] * len(obj["event"]))],
+        )
 
 
 def encode_strings(values: list) -> tuple[np.ndarray, np.ndarray]:

@@ -353,6 +353,21 @@ class LEvents(abc.ABC):
             ]
         return [self.insert_dedup(e, app_id, channel_id) for e in events]
 
+    def ingest_chunk(
+        self, chunk, app_id: int, channel_id: int | None = None
+    ) -> list[tuple[str, bool]]:
+        """Bulk-ingest one pre-parsed columnar chunk
+        (:class:`~predictionio_tpu.data.columns.EventChunk`); returns
+        ``(event_id, duplicate)`` per row, aligned with the chunk.
+
+        This is the append stage of the streaming bulk route and ``pio
+        import``'s pipeline. The base default decodes the chunk into
+        events and reuses :meth:`insert_batch_dedup` — correct on every
+        driver; the columnar driver overrides it with a vectorized
+        dedup probe plus a direct explicit-id segment write so bulk
+        ingest never constructs per-event objects at all."""
+        return self.insert_batch_dedup(chunk.to_events(), app_id, channel_id)
+
     @abc.abstractmethod
     def get(self, event_id: str, app_id: int, channel_id: int | None = None) -> Event | None: ...
 

@@ -41,6 +41,7 @@ import json
 import os
 import shutil
 import threading
+import time
 import uuid
 import zlib
 from typing import Any, Iterable, Iterator, Sequence
@@ -48,6 +49,7 @@ from typing import Any, Iterable, Iterator, Sequence
 import numpy as np
 
 from predictionio_tpu.data.columns import (
+    EventChunk,
     EventColumns,
     columns_from_events,
     encode_strings,
@@ -146,9 +148,15 @@ class _Segment:
     propf: dict[str, np.ndarray]  # float64, NaN = absent
     propint: dict[str, np.ndarray]  # bool: value was an int
     extra: np.ndarray | None  # unicode JSON residue, "" = none
-    #: explicit per-row event ids (compacted-tail segments); None =
-    #: positional "<segment>@<row>" ids (bulk-written segments)
+    #: explicit per-row event ids (compacted-tail and bulk-chunk
+    #: segments); None = positional "<segment>@<row>" ids
     ids: np.ndarray | None = None
+    #: True = written by the bulk-chunk append path. The tail follower's
+    #: compaction re-anchor must never treat a bulk segment as part of
+    #: the consumed TAIL prefix — its rows were never tail lines.
+    #: (Explicit-id segments without the flag are compacted tails, which
+    #: keeps pre-flag stores reading exactly as before.)
+    bulk: bool = False
 
     def __len__(self) -> int:
         return int(self.ev_code.shape[0])
@@ -219,6 +227,7 @@ def _load_segment(path: str) -> _Segment:
         propint=propint,
         extra=data.get("extra"),
         ids=data.get("ids"),
+        bulk=bool(data["bulk"]) if "bulk" in data else False,
     )
 
 
@@ -236,9 +245,18 @@ class _ColumnarEvents(LEvents):
     #: the record — after a restart the window re-warms from it.
     _DEDUP_WINDOW = 100_000
 
+    #: byte budget of the startup dedup warm (tail suffix + explicit-id
+    #: segment ids). A huge uncompacted tail used to be read WHOLE on
+    #: first insert; now the warm seeks to the last ``warm_bytes`` of it
+    #: (byte-offset cursor style) and stops folding segment ids in once
+    #: the budget is spent — completeness is given up instead of open
+    #: latency. DEDUP_WARM_BYTES in the source config overrides.
+    _DEDUP_WARM_BYTES = 64 * 1024 * 1024
+
     def __init__(self, base: str, segment_rows: int, fsync: bool,
                  cache_segments: int | None = None,
-                 dedup_window: int | None = None):
+                 dedup_window: int | None = None,
+                 dedup_warm_bytes: int | None = None):
         self._base = base
         self._segment_rows = segment_rows
         self._fsync = fsync
@@ -248,13 +266,22 @@ class _ColumnarEvents(LEvents):
         self._seg_cache: "OrderedDict[str, _Segment]" = OrderedDict()
         #: stream dir -> LRU of recently seen event ids (insert_dedup)
         self._recent_ids: dict[str, "OrderedDict[str, None]"] = {}
-        #: stream dir -> does the LRU provably hold EVERY live tail id?
-        #: (warmed from a tail that fit the window and never evicted
-        #: since). While True, a dedup miss can skip the O(tail) scan
-        #: and check only the indexed segments.
+        #: stream dir -> does the LRU provably hold EVERY client-visible
+        #: id in the stream (live tail lines AND explicit-id segment
+        #: rows)? Warmed under the byte budget and never evicted since.
+        #: While True, a dedup miss proves the id fresh without touching
+        #: the store (positional ``seg@row`` ids keep their routed
+        #: lookup) — the invariant the bulk route's throughput rests on.
         self._recent_complete: dict[str, bool] = {}
+        #: stream dir -> milliseconds the startup dedup warm took
+        self._warm_ms: dict[str, float] = {}
         self._dedup_window = (
             self._DEDUP_WINDOW if dedup_window is None else max(1, dedup_window)
+        )
+        self._dedup_warm_bytes = (
+            self._DEDUP_WARM_BYTES
+            if dedup_warm_bytes is None
+            else max(4096, dedup_warm_bytes)
         )
         #: per-path point-lookup indexes: None = positional segment
         #: (cached indefinitely — a few bytes), (sorted ids, argsort
@@ -273,6 +300,35 @@ class _ColumnarEvents(LEvents):
     def _stream_dir(self, app_id: int, channel_id: int | None) -> str:
         ch = "default" if channel_id is None else f"ch{channel_id}"
         return os.path.join(self._base, f"app_{app_id}", ch)
+
+    def _stream_dirs(self) -> Iterator[tuple[int, int | None, str]]:
+        """Every stream on disk as ``(app_id, channel_id, dir)`` — the
+        ONE place that parses the ``app_<id>/<default|ch<N>>`` layout
+        back out (recovery sweep + compaction scheduler both walk it)."""
+        if not os.path.isdir(self._base):
+            return
+        for app in sorted(os.listdir(self._base)):
+            app_dir = os.path.join(self._base, app)
+            if not (app.startswith("app_") and os.path.isdir(app_dir)):
+                continue
+            try:
+                app_id = int(app[len("app_"):])
+            except ValueError:
+                continue
+            for ch in sorted(os.listdir(app_dir)):
+                d = os.path.join(app_dir, ch)
+                if not os.path.isdir(d):
+                    continue
+                if ch == "default":
+                    channel_id: int | None = None
+                elif ch.startswith("ch"):
+                    try:
+                        channel_id = int(ch[2:])
+                    except ValueError:
+                        continue
+                else:
+                    continue
+                yield app_id, channel_id, d
 
     def _ensure_stream(self, app_id: int, channel_id: int | None) -> str:
         d = self._stream_dir(app_id, channel_id)
@@ -442,18 +498,12 @@ class _ColumnarEvents(LEvents):
             "quarantined": [],
             "replayedCommits": 0,
             "tornTailLines": 0,
+            "dedupWarmMs": 0.0,
+            "dedupWarmedStreams": 0,
         }
         if not os.path.isdir(self._base):
             return report
-        stream_dirs = []
-        for app in sorted(os.listdir(self._base)):
-            app_dir = os.path.join(self._base, app)
-            if not (app.startswith("app_") and os.path.isdir(app_dir)):
-                continue
-            for ch in sorted(os.listdir(app_dir)):
-                d = os.path.join(app_dir, ch)
-                if os.path.isdir(d):
-                    stream_dirs.append(d)
+        stream_dirs = [d for _, _, d in self._stream_dirs()]
         with self._lock:
             for d in stream_dirs:
                 report["streams"] += 1
@@ -478,6 +528,12 @@ class _ColumnarEvents(LEvents):
                             d, os.path.join(d, name), report
                         )
                 self._repair_tail(d, report)
+                # eager, byte-bounded dedup warm: pay the (measured)
+                # cost at open instead of on the first POST's latency
+                self._recent_ids_for(d)
+            warm = self.dedup_warm_stats()
+            report["dedupWarmMs"] = warm["dedupWarmMs"]
+            report["dedupWarmedStreams"] = warm["dedupWarmedStreams"]
         return report
 
     def _tombstones(self, d: str) -> set[str]:
@@ -584,6 +640,7 @@ class _ColumnarEvents(LEvents):
                 del self._ids_cache[p]
             self._recent_ids.pop(d, None)
             self._recent_complete.pop(d, None)
+            self._warm_ms.pop(d, None)
         return True
 
     def insert(self, event: Event, app_id: int, channel_id: int | None = None) -> str:
@@ -633,31 +690,97 @@ class _ColumnarEvents(LEvents):
 
     # ----------------------------------------------------- idempotent insert
     def _recent_ids_for(self, d: str) -> "Any":
-        """The stream's recent-id LRU, warmed from the live tail on first
-        use (so dedup keeps working across a process restart without a
-        per-insert tail scan). Caller holds the store lock."""
+        """The stream's recent-id LRU, warmed on first use from the tail
+        SUFFIX (seek to the last ``dedup_warm_bytes``, byte-offset
+        style) plus the explicit-id segments while the byte budget and
+        the window hold — so dedup keeps working across a process
+        restart without an unbounded tail read. The warm is timed
+        (``dedupWarmMs`` in ``recovery_report()``). Caller holds the
+        store lock."""
         lru = self._recent_ids.get(d)
         if lru is None:
+            t0 = time.perf_counter()
             from collections import OrderedDict
 
             lru = OrderedDict()
+            complete = True
+            budget = self._dedup_warm_bytes
+            tail_path = os.path.join(d, "tail.jsonl")
+            raw: list[bytes] = []
             try:
-                with open(os.path.join(d, "tail.jsonl")) as f:
+                size = os.path.getsize(tail_path)
+            except OSError:
+                size = 0
+            if size:
+                with open(tail_path, "rb") as f:
+                    if size > budget:
+                        # warm only the newest `budget` bytes; the
+                        # skipped prefix may hold live ids, so coverage
+                        # can no longer be proven
+                        f.seek(size - budget)
+                        f.readline()  # drop the partial first line
+                        complete = False
                     raw = [ln for ln in f if ln.strip()]
-            except FileNotFoundError:
-                raw = []
             for line in raw[-self._dedup_window:]:
                 try:
                     eid = json.loads(line).get("eventId")
-                except json.JSONDecodeError:
+                except (json.JSONDecodeError, UnicodeDecodeError):
                     continue  # torn line; the recovery sweep owns repair
                 if eid:
                     lru[str(eid)] = None
+            if len(raw) > self._dedup_window:
+                complete = False
+            # fold explicit-id segment ids in (bulk chunks, compacted
+            # tails) while the byte budget and the window hold — this is
+            # what lets a complete-window miss skip the per-segment
+            # probe entirely on the bulk hot path. Positional segments
+            # carry no client ids, so they cost nothing and never break
+            # completeness (the presence probe reads only the npz
+            # directory, not the data) — a store dominated by one huge
+            # write_columns segment must not lose the fast path over it.
+            budget -= size if size <= budget else budget
+            for path in self._segment_paths(d):
+                ids = None
+                cost = 0
+                try:
+                    seg = self._seg_cache.get(path)
+                    if seg is not None:
+                        ids = seg.ids  # already resident: free
+                    elif path in self._ids_cache:
+                        index = self._ids_cache[path]
+                        ids = None if index is None else index[0]
+                    else:
+                        with np.load(path, allow_pickle=False) as z:
+                            if "ids" in z.files:
+                                cost = os.path.getsize(path)
+                                if cost > budget:
+                                    complete = False
+                                    break
+                                ids = z["ids"]
+                except OSError:
+                    complete = False
+                    break
+                if ids is None:  # positional segment: no client ids
+                    continue
+                if len(lru) + ids.size > self._dedup_window:
+                    complete = False
+                    break
+                budget -= cost
+                for s in ids:
+                    lru[str(s)] = None
             self._recent_ids[d] = lru
-            # every live tail line made it into the window (torn lines
-            # were never acked) -> an LRU miss rules the tail out
-            self._recent_complete[d] = len(raw) <= self._dedup_window
+            self._recent_complete[d] = complete
+            self._warm_ms[d] = (time.perf_counter() - t0) * 1000.0
         return lru
+
+    def dedup_warm_stats(self) -> dict:
+        """Aggregate warm cost across streams (``recovery_report()`` /
+        the event server's ``/stats.json`` dedup section)."""
+        with self._lock:
+            return {
+                "dedupWarmMs": round(sum(self._warm_ms.values()), 3),
+                "dedupWarmedStreams": len(self._warm_ms),
+            }
 
     def _remember_id(self, d: str, lru: "Any", eid: str) -> None:
         lru[eid] = None
@@ -697,12 +820,18 @@ class _ColumnarEvents(LEvents):
                     lru.move_to_end(eid)
                     out.append((eid, True))
                     continue
-                # LRU miss. When the window provably covers the whole
-                # tail, only the (indexed, O(log rows)) segments remain
-                # to check; otherwise fall back to the exact full lookup
-                # — never an O(tail) decode per insert on the hot path.
+                # LRU miss. When the window provably covers every
+                # client-visible id (tail AND explicit-id segments), the
+                # miss itself proves freshness — only positional
+                # ``seg@row`` ids (which are never in the window) still
+                # need their routed lookup. Otherwise fall back to the
+                # exact full lookup — never an O(tail) decode per insert
+                # on the hot path.
                 if self._recent_complete.get(d, False):
-                    dup = self._lookup_segments(eid, d) is not None
+                    dup = (
+                        "@" in eid
+                        and self._lookup_segments(eid, d) is not None
+                    )
                 else:
                     dup = self._lookup(eid, d)[0] is not None
                 self._remember_id(d, lru, eid)  # also dedups within the batch
@@ -714,6 +843,253 @@ class _ColumnarEvents(LEvents):
             if fresh:
                 self.insert_batch(fresh, app_id, channel_id)
         return out  # type: ignore[return-value]
+
+    # ------------------------------------------------------ bulk chunk ingest
+    @staticmethod
+    def _window_probe(ids_py: list, lru: "Any") -> np.ndarray:
+        """Chunk-batched membership probe against the recent-id window:
+        one C-level ``np.fromiter`` pass of hashed lookups — O(chunk),
+        no per-event python frames, and (unlike a sorted-array merge)
+        no O(window) maintenance per chunk. Returns the known-duplicate
+        mask. Caller holds the store lock."""
+        return np.fromiter(
+            (i in lru for i in ids_py), dtype=bool, count=len(ids_py)
+        )
+
+    def _store_probe(
+        self, d: str, probe: np.ndarray, probe_py: list
+    ) -> np.ndarray:
+        """Exact-store half of the chunk dedup: vectorized searchsorted
+        through every explicit-id segment index plus ONE tail scan —
+        only reached when the window cannot prove freshness (store
+        bigger than the window / warm budget). Caller holds the lock."""
+        m = probe.shape[0]
+        hit = np.zeros(m, dtype=bool)
+        for path in self._segment_paths(d):
+            index = self._segment_id_index(path)
+            if index is None:
+                continue
+            sorted_ids, _ = index
+            pos = np.searchsorted(sorted_ids, probe)
+            inb = pos < sorted_ids.size
+            eq = np.zeros(m, dtype=bool)
+            eq[inb] = (
+                sorted_ids[np.minimum(pos[inb], sorted_ids.size - 1)]
+                == probe[inb]
+            )
+            hit |= eq
+        # positional seg@row ids: the routed per-id lookup (rare — only
+        # ids that syntactically name a positional segment row)
+        for j in np.flatnonzero(~hit):
+            if "@" in probe_py[j] and self._lookup_segments(
+                probe_py[j], d
+            ) is not None:
+                hit[j] = True
+        if not self._recent_complete.get(d, False):
+            tail_ids = self._tail_id_set(d)
+            for j in np.flatnonzero(~hit):
+                if probe_py[j] in tail_ids:
+                    hit[j] = True
+        return hit
+
+    def _tail_id_set(self, d: str) -> set:
+        """One pass over the live tail collecting event ids — amortizes
+        the incomplete-window fallback to one scan per CHUNK instead of
+        one per id."""
+        out: set[str] = set()
+        try:
+            with open(os.path.join(d, "tail.jsonl"), "rb") as f:
+                for ln in f:
+                    if not ln.strip():
+                        continue
+                    try:
+                        eid = json.loads(ln).get("eventId")
+                    except (json.JSONDecodeError, UnicodeDecodeError):
+                        continue
+                    if eid:
+                        out.add(str(eid))
+        except FileNotFoundError:
+            pass
+        return out
+
+    def ingest_chunk(
+        self, chunk: EventChunk, app_id: int, channel_id: int | None = None
+    ) -> list[tuple[str, bool]]:
+        """Bulk-route append: one pre-parsed chunk lands as ONE
+        explicit-id columnar segment, dedup on — no per-event dicts, no
+        tail JSON re-encode, one fsync'd file write.
+
+        Dedup order: (1) vectorized window probe (searchsorted + LRU);
+        (2) intra-chunk repeats via ``np.unique`` (first occurrence
+        wins, same rule as the batch route); (3) exact store probe only
+        when the window is not provably complete. Fresh rows are written
+        with their ids (``ids`` column) so they stay fetchable,
+        deletable, follower-visible, and dedup-durable across restarts;
+        the whole check+append runs under one store lock so concurrent
+        retries of the same chunk cannot both pass the membership test."""
+        n = len(chunk)
+        if n == 0:
+            return []
+        self.init(app_id, channel_id)
+        d = self._stream_dir(app_id, channel_id)
+        ids_py = chunk.ids
+        with self._lock:
+            self._recover(d)
+            lru = self._recent_ids_for(d)
+            dup = self._window_probe(ids_py, lru)
+            if dup.all():
+                keep = None  # pure retransmit: nothing to write
+            else:
+                # intra-chunk repeats: np.unique keeps the FIRST occurrence
+                ids_arr = np.asarray(ids_py, dtype=np.str_)
+                first = np.unique(ids_arr, return_index=True)[1]
+                keep = np.zeros(n, dtype=bool)
+                keep[first] = True
+                if self._recent_complete.get(d, False):
+                    # positional seg@row ids are never in the window —
+                    # they keep their routed lookup, like the single
+                    # route's complete-window fast path (the any() scan
+                    # keeps the common no-"@" chunk one C pass)
+                    if any("@" in s for s in ids_py):
+                        for i in np.flatnonzero(~dup & keep).tolist():
+                            if "@" in ids_py[i] and self._lookup_segments(
+                                ids_py[i], d
+                            ) is not None:
+                                dup[i] = True
+                else:
+                    rest = np.flatnonzero(~dup & keep)
+                    if rest.size:
+                        dup[rest] = self._store_probe(
+                            d, ids_arr[rest], [ids_py[i] for i in rest]
+                        )
+            if keep is None:
+                row_dup = dup
+            else:
+                row_dup = dup | ~keep
+                fresh = np.flatnonzero(keep & ~dup)
+                if fresh.size:
+                    self._write_chunk_segment(
+                        chunk, fresh, ids_arr, app_id, channel_id
+                    )
+                    # bulk-remember: insert everything, trim the window
+                    # once (a fresh id lands at the LRU end by insertion
+                    # order, so no per-id move_to_end is needed)
+                    if fresh.size == n:
+                        lru.update(dict.fromkeys(ids_py))
+                    else:
+                        for i in fresh.tolist():
+                            lru[ids_py[i]] = None
+                    overflow = len(lru) - self._dedup_window
+                    if overflow > 0:
+                        for _ in range(overflow):
+                            lru.popitem(last=False)
+                        self._recent_complete[d] = False
+        return list(zip(ids_py, row_dup.tolist()))
+
+    def _write_chunk_segment(
+        self,
+        chunk: EventChunk,
+        rows: np.ndarray,
+        ids_arr: np.ndarray,
+        app_id: int,
+        channel_id: int | None,
+    ) -> None:
+        """Encode the fresh rows of one chunk straight into a segment —
+        the vectorized mirror of ``_write_segment_from_events`` (string
+        dictionary encoding via ``np.unique``, numeric columns sliced,
+        ids kept). The common all-rows-fresh case skips every
+        fancy-index copy."""
+        n = len(chunk)
+        whole = rows.size == n
+
+        def col_str(values: list) -> np.ndarray:
+            arr = np.asarray(values, dtype=np.str_)
+            return arr if whole else arr[rows]
+
+        def col_num(arr: np.ndarray) -> np.ndarray:
+            return arr if whole else arr[rows]
+
+        # uniform single-value columns (one event name / entity type per
+        # stream is the norm) skip the np.unique sort entirely
+        def encode_maybe_uniform(values: list) -> tuple[np.ndarray, np.ndarray]:
+            first = values[0]
+            arr = col_str(values)
+            if (arr == first).all():
+                return (
+                    np.zeros(arr.shape[0], np.int32),
+                    np.asarray([first], dtype=np.str_),
+                )
+            return encode_strings(arr)
+
+        ev_code, ev_vocab = encode_maybe_uniform(chunk.event)
+        etype_code, etype_vocab = encode_maybe_uniform(chunk.entity_type)
+        eid_code, eid_vocab = encode_strings(col_str(chunk.entity_id))
+
+        def encode_opt(values: list) -> tuple[np.ndarray, np.ndarray]:
+            picked = values if whole else [values[i] for i in rows.tolist()]
+            if None not in picked:
+                return encode_strings(np.asarray(picked, dtype=np.str_))
+            present = [v for v in picked if v is not None]
+            codes = np.full(len(picked), -1, np.int32)
+            if not present:
+                return codes, np.zeros(0, dtype="<U1")
+            p_codes, vocab = encode_strings(present)
+            codes[[i for i, v in enumerate(picked) if v is not None]] = p_codes
+            return codes, vocab
+
+        ttype_code, ttype_vocab = encode_opt(chunk.target_entity_type)
+        tid_code, tid_vocab = encode_opt(chunk.target_entity_id)
+        arrays: dict[str, np.ndarray] = {
+            "ev_code": ev_code, "ev_vocab": ev_vocab,
+            "etype_code": etype_code, "etype_vocab": etype_vocab,
+            "eid_code": eid_code, "eid_vocab": eid_vocab,
+            "ttype_code": ttype_code, "ttype_vocab": ttype_vocab,
+            "tid_code": tid_code, "tid_vocab": tid_vocab,
+            "t_us": col_num(chunk.t_us),
+            "c_us": col_num(chunk.c_us),
+        }
+        for k, col in chunk.propf.items():
+            arrays[f"propf_{k}"] = col_num(col)
+            arrays[f"propint_{k}"] = col_num(chunk.propint[k])
+        extra = col_str(chunk.extra)
+        if np.any(extra != ""):
+            arrays["extra"] = extra
+        arrays["ids"] = col_num(ids_arr)
+        # provenance marker: bulk segments are never part of the
+        # consumed tail prefix (see tail_follow's re-anchor)
+        arrays["bulk"] = np.asarray(True)
+        self._save_segment(arrays, app_id, channel_id)
+
+    # --------------------------------------------- compaction watermarks
+    def stream_stats(self) -> list[dict]:
+        """Per-stream watermark inputs for the background compaction
+        scheduler: tail bytes, dead tail tombstones, segment count —
+        everything readable without decoding a single event."""
+        out: list[dict] = []
+        for app_id, channel_id, d in self._stream_dirs():
+            try:
+                tail_bytes = os.path.getsize(os.path.join(d, "tail.jsonl"))
+            except OSError:
+                tail_bytes = 0
+            dead = 0
+            try:
+                with open(os.path.join(d, "tombstones.txt")) as f:
+                    for line in f:
+                        if line.startswith("t:"):
+                            dead += 1
+            except OSError:
+                pass
+            out.append(
+                {
+                    "app_id": app_id,
+                    "channel_id": channel_id,
+                    "tail_bytes": tail_bytes,
+                    "dead_tail_tombstones": dead,
+                    "segments": len(self._segment_paths(d)),
+                    "compactions": self._compactions(d),
+                }
+            )
+        return out
 
     # ------------------------------------------------------- tail following
     #: consumed tail event ids remembered in a follow cursor. After a
@@ -939,12 +1315,16 @@ class _ColumnarEvents(LEvents):
                 tail_start = 0  # tail_objs already IS the delta
         else:
             # compaction(s) landed: locate the consumed prefix inside the
-            # new explicit-id segments via the newest chain id present
+            # new COMPACTED explicit-id segments via the newest chain id
+            # present. Bulk-chunk segments (seg.bulk) never held tail
+            # lines, so they are excluded from both the anchor search
+            # and the prefix skip — they are read in full like any other
+            # segment roll, even when they sorted before the cut.
             loaded = {p: self._segment(p) for p in new_paths}
             cut: tuple[int, int] | None = None
             for si, p in enumerate(new_paths):
                 seg = loaded[p]
-                if seg.ids is None:
+                if seg.ids is None or seg.bulk:
                     continue
                 for cid in reversed(chain):  # newest consumed first
                     hits = np.flatnonzero(seg.ids == cid)
@@ -956,7 +1336,7 @@ class _ColumnarEvents(LEvents):
             seg_plan = []
             for si, p in enumerate(new_paths):
                 seg = loaded[p]
-                if cut is not None and seg.ids is not None:
+                if cut is not None and seg.ids is not None and not seg.bulk:
                     if si < cut[0]:
                         continue  # fully inside the consumed prefix
                     if si == cut[0]:
@@ -1116,10 +1496,19 @@ class _ColumnarEvents(LEvents):
             index = (ids[order], order)
         with self._lock:
             self._ids_cache[path] = index
-            # None markers are tiny; only bound the real indexes
-            real = [k for k, v in self._ids_cache.items() if v is not None]
-            while len(real) > max(self._cache_segments, 1):
+            # None markers are tiny; bound the real indexes by TOTAL
+            # indexed rows, not file count — the bulk route writes many
+            # small chunk segments, and a per-file cap would thrash
+            # their indexes on every dedup probe while one huge
+            # compacted segment still fits the same budget
+            budget = max(self._cache_segments, 1) * 512_000
+            real = [
+                k for k, v in self._ids_cache.items() if v is not None
+            ]
+            rows = sum(self._ids_cache[k][0].size for k in real)
+            while rows > budget and len(real) > 1:
                 victim = real.pop(0)
+                rows -= self._ids_cache[victim][0].size
                 del self._ids_cache[victim]
         return index
 
@@ -1694,9 +2083,10 @@ class StorageClient(BaseStorageClient):
 
         PIO_STORAGE_SOURCES_<ID>_TYPE=columnar
         PIO_STORAGE_SOURCES_<ID>_PATH=/data/pio-events
-        PIO_STORAGE_SOURCES_<ID>_SEGMENT_ROWS=1000000   # optional
-        PIO_STORAGE_SOURCES_<ID>_FSYNC=false            # optional
-        PIO_STORAGE_SOURCES_<ID>_DEDUP_WINDOW=100000    # optional
+        PIO_STORAGE_SOURCES_<ID>_SEGMENT_ROWS=1000000        # optional
+        PIO_STORAGE_SOURCES_<ID>_FSYNC=false                 # optional
+        PIO_STORAGE_SOURCES_<ID>_DEDUP_WINDOW=100000         # optional
+        PIO_STORAGE_SOURCES_<ID>_DEDUP_WARM_BYTES=67108864   # optional
 
     On open, the driver runs a startup recovery sweep (quarantines orphan
     temp/staging files, replays committed compactions, trims torn tail
@@ -1715,6 +2105,7 @@ class StorageClient(BaseStorageClient):
         fsync = config.properties.get("fsync", "false").lower() == "true"
         cache_segments = config.properties.get("cache_segments")
         dedup_window = config.properties.get("dedup_window")
+        dedup_warm_bytes = config.properties.get("dedup_warm_bytes")
         base = os.path.join(os.path.expanduser(path), f"{prefix}_events")
         os.makedirs(base, exist_ok=True)
         self._events = _ColumnarEvents(
@@ -1724,6 +2115,9 @@ class StorageClient(BaseStorageClient):
             ),
             dedup_window=(
                 int(dedup_window) if dedup_window is not None else None
+            ),
+            dedup_warm_bytes=(
+                int(dedup_warm_bytes) if dedup_warm_bytes is not None else None
             ),
         )
         self._pevents = _ColumnarPEvents(self._events)

@@ -53,6 +53,7 @@ import urllib.request
 from typing import Any, Iterable, Iterator, Mapping, Sequence
 
 from predictionio_tpu import resilience
+from predictionio_tpu.data.columns import EventChunk
 from predictionio_tpu.data.event import DataMap, Event
 from predictionio_tpu.data.storage.base import (
     AccessKey,
@@ -798,6 +799,30 @@ class _RemoteLEvents(LEvents):
             return [(eid, False) for eid in ids]
         return [(eid, bool(dup)) for eid, dup in result]
 
+    def ingest_chunk(
+        self, chunk: EventChunk, app_id: int, channel_id: int | None = None
+    ) -> list[tuple[str, bool]]:
+        """Bulk-chunk RPC: the whole pre-parsed chunk crosses the wire
+        once (column lists, not per-event dicts) and the server lands it
+        through its backend's vectorized path. Ids are stamped at parse
+        time, so the call is retry-safe (``idempotent=True``). Falls
+        back to the decoded batch-dedup path on servers that predate the
+        bulk SPI."""
+        args = {
+            "chunk": chunk.to_wire(),
+            "app_id": app_id,
+            "channel_id": channel_id,
+        }
+        try:
+            result = self._rpc.call(
+                "l_events", "ingest_chunk", args, idempotent=True
+            )
+        except StorageError as e:
+            if "unknown method" not in str(e):
+                raise
+            return LEvents.ingest_chunk(self, chunk, app_id, channel_id)
+        return [(str(eid), bool(dup)) for eid, dup in result]
+
     def compact(self, app_id: int, channel_id: int | None = None) -> int:
         """Proxy of the columnar driver's tail compaction; StorageError
         when the backing store has no tail/segment layout."""
@@ -1060,8 +1085,8 @@ class StorageRpcService:
         "l_events": frozenset(
             (
                 "init", "remove", "insert", "insert_batch", "insert_dedup",
-                "insert_batch_dedup", "get", "delete", "find", "find_page",
-                "compact",
+                "insert_batch_dedup", "ingest_chunk", "get", "delete",
+                "find", "find_page", "compact",
             )
         ),
         "p_events": frozenset(("find", "find_page", "write", "delete")),
@@ -1112,6 +1137,21 @@ class StorageRpcService:
                 f"unknown method '{role}.{method}' (backing event store "
                 "has no dedup index)"
             )
+        if method == "ingest_chunk":
+            # same contract for the bulk chunk RPC: it is only
+            # advertised when the backing driver can actually dedup
+            # (native chunk path or a real insert_batch_dedup override)
+            has_native = (
+                getattr(type(repo), "ingest_chunk", None)
+                is not LEvents.ingest_chunk
+            )
+            if not (
+                has_native or _driver_has_dedup(repo, "insert_batch_dedup")
+            ):
+                raise StorageError(
+                    f"unknown method '{role}.{method}' (backing event "
+                    "store has no dedup index)"
+                )
         # find_page is a server-layer verb over the repo's find iterator,
         # not an SPI method — resolved after arg decoding below
         fn = None if method == "find_page" else getattr(repo, method)
@@ -1130,6 +1170,8 @@ class StorageRpcService:
                 kwargs["event"] = _event_from_wire(kwargs["event"])
             if "events" in kwargs:
                 kwargs["events"] = [_event_from_wire(e) for e in kwargs["events"]]
+            if "chunk" in kwargs:
+                kwargs["chunk"] = EventChunk.from_wire(kwargs["chunk"])
             for tkey in ("start_time", "until_time"):
                 if tkey in kwargs:
                     kwargs[tkey] = _dt_from(kwargs[tkey])

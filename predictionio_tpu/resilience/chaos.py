@@ -76,6 +76,10 @@ class ChaosConfig:
     events_per_writer: int = 120  # across the whole run, per writer
     backend: str = "sqlite"  # sqlite | columnar (columnar forces FSYNC=true)
     seed: int = 0
+    #: events streamed through POST /events/bulk.json in the bulk-writer
+    #: phase (SIGKILL lands mid-stream; the whole stream is retried with
+    #: the same ids until a clean summary). 0 disables the phase.
+    bulk_events: int = 1000
     drain_deadline_s: float = 5.0  # the SIGTERM-under-load phase
     startup_timeout_s: float = 60.0
     #: overall wall-clock budget; expiry fails the run rather than hanging CI
@@ -345,6 +349,281 @@ def _unquarantined_torn_files(base: str) -> list[str]:
     return sorted(bad)
 
 
+class _BulkStreamAttempt:
+    """One full-duplex attempt at streaming the bulk payload: the
+    sender thread (caller) trickles chunked-transfer frames while a
+    reader thread collects the per-chunk NDJSON statuses as they
+    arrive — so a SIGKILL mid-stream leaves a truthful record of
+    exactly which chunks were ACKED before the socket died."""
+
+    def __init__(self, port: int):
+        self.statuses: list[dict] = []
+        self.summary: dict | None = None
+        self.error: str | None = None
+        self._sock = socket.create_connection(("127.0.0.1", port), timeout=10)
+        head = (
+            f"POST /events/bulk.json?accessKey={_ACCESS_KEY}&chunkRows=200 "
+            "HTTP/1.1\r\n"
+            f"Host: 127.0.0.1:{port}\r\n"
+            "Content-Type: application/x-ndjson\r\n"
+            "Transfer-Encoding: chunked\r\n\r\n"
+        ).encode()
+        self._sock.sendall(head)
+        self._reader = threading.Thread(
+            target=self._read_response, name="chaos-bulk-reader", daemon=True
+        )
+        self._reader.start()
+
+    def send_piece(self, piece: bytes) -> None:
+        self._sock.sendall(
+            f"{len(piece):X}\r\n".encode() + piece + b"\r\n"
+        )
+
+    def finish_send(self) -> None:
+        self._sock.sendall(b"0\r\n\r\n")
+
+    def _read_response(self) -> None:
+        try:
+            f = self._sock.makefile("rb")
+            status_line = f.readline()
+            if b"200" not in status_line:
+                self.error = f"unexpected status {status_line!r}"
+                return
+            while True:  # headers
+                line = f.readline()
+                if not line or line in (b"\r\n", b"\n"):
+                    break
+            buf = b""
+            while True:  # de-chunk the response stream
+                size_line = f.readline()
+                if not size_line:
+                    break
+                size = int(size_line.split(b";")[0].strip() or b"0", 16)
+                if size == 0:
+                    break
+                buf += f.read(size)
+                f.read(2)
+                while b"\n" in buf:
+                    line, _, buf = buf.partition(b"\n")
+                    if not line.strip():
+                        continue
+                    obj = json.loads(line)
+                    if obj.get("done"):
+                        self.summary = obj
+                    else:
+                        self.statuses.append(obj)
+        except (OSError, ValueError) as e:
+            self.error = str(e)
+
+    def wait(self, timeout_s: float) -> None:
+        self._reader.join(timeout=timeout_s)
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+def _bulk_phase(env: dict, cfg: ChaosConfig, rng: random.Random,
+                base: str) -> dict:
+    """Bulk-route chaos: stream ``bulk_events`` NDJSON events with
+    deterministic client ids through ``POST /events/bulk.json``
+    (chunked transfer, trickled), SIGKILL the server mid-stream, then
+    retry the WHOLE stream with the same ids until a clean summary —
+    while a side writer keeps single-event POSTs flowing so the tail
+    (and, on the columnar backend, the background compaction scheduler
+    started via ``--compact-*``) churns underneath. Verdict: every
+    acked chunk's events survive exactly once, retries are absorbed as
+    duplicates, no unquarantined torn chunk files remain."""
+    port = _free_port()
+    extra: tuple[str, ...] = ("--stats",)
+    if cfg.backend == "columnar":
+        # aggressive scheduler: compaction generation bumps land DURING
+        # the bulk stream and the kill window
+        extra += (
+            "--compact-interval-s", "0.3",
+            "--compact-tail-mb", "0.0001",
+            "--compact-min-interval-s", "0.2",
+        )
+    server = _ServerProc(env, port, extra_args=extra)
+    lines = [
+        json.dumps(
+            {
+                "eventId": f"bulk-e{i:05d}",
+                "event": "rate",
+                "entityType": "user",
+                "entityId": f"bu{i % 13}",
+                "targetEntityType": "item",
+                "targetEntityId": f"bi{i % 41}",
+                "properties": {"rating": float(1 + i % 5)},
+            }
+        ).encode() + b"\n"
+        for i in range(cfg.bulk_events)
+    ]
+    ids = [f"bulk-e{i:05d}" for i in range(cfg.bulk_events)]
+    stop_side = threading.Event()
+    side_acked: dict[str, int] = {}
+    side_lock = threading.Lock()
+
+    def side_writer() -> None:
+        i = 0
+        while not stop_side.is_set():
+            i += 1
+            eid = f"bside-e{i:05d}"
+            payload = json.dumps(
+                {
+                    "eventId": eid,
+                    "event": "rate",
+                    "entityType": "user",
+                    "entityId": "side",
+                    "targetEntityType": "item",
+                    "targetEntityId": f"si{i % 7}",
+                }
+            ).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/events.json?accessKey={_ACCESS_KEY}",
+                data=payload,
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=5) as resp:
+                    body = json.loads(resp.read())
+            except Exception:
+                time.sleep(0.05)
+                continue
+            if body.get("eventId"):
+                with side_lock:
+                    side_acked[eid] = side_acked.get(eid, 0) + 1
+            time.sleep(0.01)
+
+    acked_chunk_ids: set[str] = set()
+    kills = 0
+    attempts = 0
+    report: dict[str, Any] = {"events": cfg.bulk_events}
+    try:
+        server.wait_ready(cfg.startup_timeout_s)
+        side = threading.Thread(target=side_writer, daemon=True,
+                                name="chaos-bulk-side")
+        side.start()
+        deadline = time.monotonic() + cfg.total_timeout_s / 2
+        summary = None
+        while summary is None and time.monotonic() < deadline:
+            attempts += 1
+            kill_this_attempt = kills == 0
+            kill_at = rng.uniform(0.3, 0.7) * len(lines)
+            try:
+                attempt = _BulkStreamAttempt(port)
+            except OSError:
+                time.sleep(0.1)
+                continue
+            try:
+                sent = 0
+                for lo in range(0, len(lines), 100):
+                    attempt.send_piece(b"".join(lines[lo:lo + 100]))
+                    sent += 100
+                    time.sleep(0.005)
+                    if kill_this_attempt and sent >= kill_at:
+                        server.kill9()
+                        kills += 1
+                        break
+                else:
+                    attempt.finish_send()
+                    attempt.wait(30.0)
+                    summary = attempt.summary
+            except OSError:
+                pass  # mid-kill socket death: the retry owns recovery
+            finally:
+                attempt.wait(2.0)
+                for st in attempt.statuses:
+                    lo = int(st.get("lineStart", 0))
+                    n = int(st.get("received", 0))
+                    if st.get("storageError") is None:
+                        acked_chunk_ids.update(ids[lo:lo + n])
+                attempt.close()
+            if kill_this_attempt and kills:
+                server = _ServerProc(env, port, extra_args=extra)
+                server.wait_ready(cfg.startup_timeout_s)
+        compactions = None
+        if cfg.backend == "columnar" and summary is not None:
+            # the side writer keeps the tail growing past the (tiny)
+            # watermark; wait for the scheduler to actually fire so the
+            # exactly-once verification below runs AGAINST a generation
+            # bump, not merely next to a dormant thread
+            stats_url = (
+                f"http://127.0.0.1:{port}/stats.json?accessKey={_ACCESS_KEY}"
+            )
+            wait_until = time.monotonic() + 5.0
+            while time.monotonic() < wait_until:
+                try:
+                    with urllib.request.urlopen(stats_url, timeout=5) as resp:
+                        compactions = (
+                            json.loads(resp.read())
+                            .get("compaction", {})
+                            .get("compactions")
+                        )
+                except Exception:
+                    compactions = None
+                if compactions:
+                    break
+                time.sleep(0.2)
+        stop_side.set()
+        side.join(timeout=10)
+        stored = _fetch_all_events(port)
+        counts: dict[str, int] = {}
+        for evd in stored:
+            eid = evd.get("eventId") or ""
+            counts[eid] = counts.get(eid, 0) + 1
+        bulk_lost = sorted(
+            e for e in acked_chunk_ids if counts.get(e, 0) == 0
+        )
+        bulk_dups = sorted(
+            e for e in counts
+            if e.startswith(("bulk-", "bside-")) and counts[e] > 1
+        )
+        with side_lock:
+            side_lost = sorted(
+                e for e in side_acked if counts.get(e, 0) == 0
+            )
+        report.update(
+            attempts=attempts,
+            kills=kills,
+            completed=summary is not None,
+            summary=summary,
+            ackedChunkEvents=len(acked_chunk_ids),
+            ackedLost=len(bulk_lost),
+            ackedLostIds=bulk_lost[:20],
+            duplicates=len(bulk_dups),
+            duplicateIds=bulk_dups[:20],
+            sideAcked=len(side_acked),
+            sideAckedLost=len(side_lost),
+            schedulerCompactions=compactions,
+            unquarantinedTornFiles=len(_unquarantined_torn_files(base)),
+        )
+    finally:
+        stop_side.set()
+        server.stop()
+    report["ok"] = bool(
+        report.get("completed")
+        and report.get("kills", 0) >= 1
+        and report.get("ackedLost") == 0
+        and report.get("duplicates") == 0
+        and report.get("sideAckedLost") == 0
+        and report.get("unquarantinedTornFiles") == 0
+        and (report.get("summary") or {}).get("stored", 0)
+        + (report.get("summary") or {}).get("duplicates", 0)
+        == cfg.bulk_events
+        # columnar runs the background scheduler underneath the phase;
+        # a run where it never fired proves nothing about coordination
+        and (
+            cfg.backend != "columnar"
+            or bool(report.get("schedulerCompactions"))
+        )
+    )
+    return report
+
+
 def _drain_phase(env: dict, cfg: ChaosConfig, rng: random.Random) -> dict:
     """SIGTERM under load: a fresh server with ``--drain-deadline-s``
     gets concurrent writers, then SIGTERM mid-traffic. Verdict: exit 0
@@ -534,6 +813,8 @@ def run_chaos_ingest(cfg: ChaosConfig) -> dict:
         stop.set()
         if server is not None:
             server.stop()
+    if cfg.bulk_events > 0:
+        report["bulk"] = _bulk_phase(env, cfg, rng, base)
     report["drain"] = _drain_phase(env, cfg, rng)
     if not cfg.keep_dir and cfg.base_dir is None:
         shutil.rmtree(base, ignore_errors=True)
@@ -548,6 +829,7 @@ def run_chaos_ingest(cfg: ChaosConfig) -> dict:
         and report.get("dedupViolations") == 0
         and report.get("tornRequestsStored") == 0
         and report.get("unquarantinedTornFiles") == 0
+        and (cfg.bulk_events <= 0 or report.get("bulk", {}).get("ok"))
         and drain.get("exitCode") == 0
         and drain.get("raw500s") == 0
         and drain.get("withinDeadline")

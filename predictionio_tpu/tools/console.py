@@ -405,6 +405,31 @@ def build_parser() -> argparse.ArgumentParser:
     es.add_argument("--ip", default="0.0.0.0")
     es.add_argument("--port", type=int, default=7070)
     es.add_argument("--stats", action="store_true")
+    # ---- background compaction scheduler (docs/operations.md). Strictly
+    # opt-in: 0 (default) starts no scheduler thread — tail compaction
+    # stays the manual `pio app compact` it always was (CI-guarded).
+    es.add_argument(
+        "--compact-interval-s", type=float, default=0.0, metavar="S",
+        help="sweep the columnar event store every S seconds and compact "
+        "streams past the watermarks below (0 = no background "
+        "compaction, the historical default; requires the columnar "
+        "EVENTDATA backend)",
+    )
+    es.add_argument(
+        "--compact-tail-mb", type=float, default=32.0, metavar="MB",
+        help="tail-size watermark: compact a stream whose live JSONL "
+        "tail exceeds MB mebibytes (default 32)",
+    )
+    es.add_argument(
+        "--compact-dead-tombstones", type=int, default=10000, metavar="N",
+        help="dead-bytes watermark: compact a stream with >= N "
+        "tombstoned tail events (default 10000)",
+    )
+    es.add_argument(
+        "--compact-min-interval-s", type=float, default=30.0, metavar="S",
+        help="rate limit: never compact the same stream twice within S "
+        "seconds (default 30)",
+    )
     add_ssl_flags(es)
     add_lifecycle_flags(es)
 
@@ -468,6 +493,11 @@ def build_parser() -> argparse.ArgumentParser:
         help="EVENTDATA backend under test (columnar runs with FSYNC=true)",
     )
     ch.add_argument("--seed", type=int, default=0, help="kill-schedule RNG seed")
+    ch.add_argument(
+        "--bulk-events", type=int, default=1000,
+        help="events streamed through POST /events/bulk.json in the "
+        "bulk-writer phase (SIGKILL lands mid-stream; 0 disables)",
+    )
     ch.add_argument(
         "--drain-deadline-s", type=float, default=5.0,
         help="drain deadline for the final SIGTERM-under-load phase",
@@ -891,6 +921,39 @@ def main(argv: list[str] | None = None) -> int:
             from predictionio_tpu.api.http import serve
 
             service = EventService(stats=args.stats)
+            if args.compact_interval_s and args.compact_interval_s > 0:
+                from predictionio_tpu.data.storage import Storage
+                from predictionio_tpu.data.storage.compaction import (
+                    CompactionConfig,
+                    CompactionScheduler,
+                )
+
+                le = Storage.get_l_events()
+                if not (
+                    hasattr(le, "stream_stats") and hasattr(le, "compact")
+                ):
+                    raise SystemExit(
+                        "--compact-interval-s needs an EVENTDATA backend "
+                        "with a tail to compact (TYPE=columnar)"
+                    )
+                service.compaction_scheduler = CompactionScheduler(
+                    le,
+                    CompactionConfig(
+                        interval_s=args.compact_interval_s,
+                        tail_bytes_high=int(
+                            args.compact_tail_mb * 1024 * 1024
+                        ),
+                        dead_tombstones_high=args.compact_dead_tombstones,
+                        min_interval_s=args.compact_min_interval_s,
+                    ),
+                )
+                service.compaction_scheduler.start()
+                print(
+                    "Background compaction: every "
+                    f"{args.compact_interval_s:g}s, tail >= "
+                    f"{args.compact_tail_mb:g} MiB or >= "
+                    f"{args.compact_dead_tombstones} dead tombstones"
+                )
             print(f"Event Server is listening on {args.ip}:{args.port}")
             serve(
                 service.dispatch, args.ip, args.port,
@@ -1066,6 +1129,7 @@ def main(argv: list[str] | None = None) -> int:
                     events_per_writer=args.events,
                     backend=args.backend,
                     seed=args.seed,
+                    bulk_events=args.bulk_events,
                     drain_deadline_s=args.drain_deadline_s,
                     keep_dir=args.keep,
                 )

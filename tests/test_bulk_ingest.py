@@ -1,0 +1,1280 @@
+"""Streaming bulk ingest (ISSUE 12): the NDJSON/binary bulk route, the
+pipelined parse→validate→append stages, the columnar chunk append with
+vectorized dedup, the bounded dedup warm, the background compaction
+scheduler, and the guards that keep all of it strictly additive.
+"""
+
+from __future__ import annotations
+
+import gzip
+import http.client
+import io
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.data.columns import EventChunk
+from predictionio_tpu.data.event import (
+    event_from_json,
+    parse_event_time,
+)
+from predictionio_tpu.data.ingest import (
+    ChunkResult,
+    IngestPipeline,
+    PipelineError,
+    iso_us,
+    parse_chunk,
+    parse_chunk_wire,
+    split_lines,
+)
+from predictionio_tpu.data.storage import Storage
+from predictionio_tpu.data.storage.base import StorageClientConfig
+from predictionio_tpu.data.storage import columnar
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+APP = 7
+
+
+def _line(i: int, eid: str | None = None, **over) -> bytes:
+    d = {
+        "event": "rate",
+        "entityType": "user",
+        "entityId": f"u{i % 37}",
+        "targetEntityType": "item",
+        "targetEntityId": f"i{i % 53}",
+        "properties": {"rating": float(i % 5)},
+        "eventTime": "2026-01-01T12:00:00.000+00:00",
+    }
+    if eid:
+        d["eventId"] = eid
+    d.update(over)
+    return (json.dumps(d) + "\n").encode()
+
+
+def _columnar_client(tmp_path, **props):
+    return columnar.StorageClient(
+        StorageClientConfig(
+            "C", "columnar", {"path": str(tmp_path / "cols"), **props}
+        )
+    )
+
+
+@pytest.fixture()
+def service_env(tmp_path):
+    Storage.configure(
+        {
+            "PIO_FS_BASEDIR": str(tmp_path),
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "COL",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+            "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+            "PIO_STORAGE_SOURCES_COL_TYPE": "columnar",
+            "PIO_STORAGE_SOURCES_COL_PATH": str(tmp_path / "events"),
+        }
+    )
+    from predictionio_tpu.data.storage.base import AccessKey, App
+
+    app_id = Storage.get_meta_data_apps().insert(App(id=0, name="bulkapp"))
+    Storage.get_meta_data_access_keys().insert(
+        AccessKey(key="bk", appid=app_id, events=())
+    )
+    yield Storage, app_id
+    Storage.configure(None)
+
+
+# ---------------------------------------------------------------------------
+# Timestamp fast path
+# ---------------------------------------------------------------------------
+
+
+class TestIsoUs:
+    CASES = [
+        "2026-01-01T12:00:00.000+00:00",
+        "2026-07-04T01:02:03Z",
+        "2026-07-04T01:02:03",
+        "2026-07-04T01:02:03.9999999",  # fractional carry into next second
+        "2025-12-31T23:59:59.123456-05:30",
+        "2026-02-28T23:59:59+0130",
+        "2024-02-29T00:00:00.5Z",  # leap day, fractional
+    ]
+
+    def test_matches_parse_event_time_exactly(self):
+        for s in self.CASES:
+            want = int(parse_event_time(s).timestamp() * 1e6)
+            assert iso_us(s) == want, s
+            assert iso_us(s) == want, f"memoized second call diverged: {s}"
+
+    def test_rejects_what_parse_event_time_rejects(self):
+        from predictionio_tpu.data.event import EventValidationError
+
+        for s in (
+            "not a time",
+            "2026-13-01T00:00:00",
+            "2026-01-01",
+            # out-of-range fields must NOT silently roll over: the fast
+            # path has to defer to the datetime-backed reject
+            "2026-01-01T23:75:00Z",
+            "2026-01-01T25:00:00Z",
+            "2026-01-01T00:00:99Z",
+        ):
+            with pytest.raises(EventValidationError):
+                iso_us(s)
+        # out-of-range tz offsets raise the same (bare ValueError from
+        # the timezone constructor) as parse_event_time — parity, and
+        # the bulk parser's per-line handler catches it either way
+        with pytest.raises(ValueError):
+            iso_us("2026-01-01T00:00:00+99:59")
+        with pytest.raises(ValueError):
+            parse_event_time("2026-01-01T00:00:00+99:59")
+
+
+# ---------------------------------------------------------------------------
+# NDJSON parser: validation parity + per-line error offsets
+# ---------------------------------------------------------------------------
+
+
+class TestParseChunk:
+    def test_accept_reject_parity_with_single_route(self):
+        """Every payload the single-event route accepts must parse, and
+        every payload it rejects must produce a per-line error — the
+        bulk route can never be a validation side door."""
+        payloads = [
+            {"event": "rate", "entityType": "user", "entityId": "u1"},
+            {"event": "rate", "entityType": "user", "entityId": "u1",
+             "targetEntityType": "item", "targetEntityId": "i1"},
+            {"event": "$set", "entityType": "user", "entityId": "u1",
+             "properties": {"a": 1}},
+            {"event": "$unset", "entityType": "user", "entityId": "u1",
+             "properties": {"a": 1}},
+            {"event": "$unset", "entityType": "user", "entityId": "u1"},
+            {"event": "$delete", "entityType": "user", "entityId": "u1"},
+            {"event": "$delete", "entityType": "user", "entityId": "u1",
+             "properties": {"a": 1}},
+            {"event": "$nope", "entityType": "user", "entityId": "u1"},
+            {"event": "pio_x", "entityType": "user", "entityId": "u1"},
+            {"event": "rate", "entityType": "pio_pr", "entityId": "u1"},
+            {"event": "rate", "entityType": "pio_other", "entityId": "u1"},
+            {"event": "rate", "entityType": "$t", "entityId": "u1"},
+            {"event": "", "entityType": "user", "entityId": "u1"},
+            {"event": "rate", "entityType": "", "entityId": "u1"},
+            {"event": "rate", "entityType": "user", "entityId": ""},
+            {"event": "rate", "entityType": "user"},
+            {"entityType": "user", "entityId": "u1"},
+            {"event": "rate", "entityType": "user", "entityId": "u1",
+             "targetEntityType": "item"},
+            {"event": "$set", "entityType": "user", "entityId": "u1",
+             "targetEntityType": "item", "targetEntityId": "i1"},
+            {"event": "rate", "entityType": "user", "entityId": "u1",
+             "properties": [1, 2]},
+            {"event": "rate", "entityType": "user", "entityId": "u1",
+             "tags": "notalist"},
+            {"event": "rate", "entityType": "user", "entityId": "u1",
+             "eventId": 5},
+            {"event": "rate", "entityType": "user", "entityId": "u1",
+             "eventTime": "garbage"},
+            {"event": "rate", "entityType": "user", "entityId": "u1",
+             "tags": ["a", "b"], "prId": "p1"},
+        ]
+        lines = [(json.dumps(p) + "\n").encode() for p in payloads]
+        outcome = parse_chunk(lines, 0)
+        rejected = {e["line"] for e in outcome.errors}
+        for i, p in enumerate(payloads):
+            try:
+                event_from_json(p)
+                single_ok = True
+            except Exception:
+                single_ok = False
+            assert (i not in rejected) == single_ok, (
+                f"line {i} parity break ({p}): single_ok={single_ok}, "
+                f"errors={outcome.errors}"
+            )
+        assert outcome.received == len(payloads)
+        assert len(outcome.row_lines) + len(outcome.errors) == len(payloads)
+
+    def test_decoded_rows_match_event_from_json(self):
+        obj = {
+            "eventId": "e1", "event": "rate", "entityType": "user",
+            "entityId": "u9", "targetEntityType": "item",
+            "targetEntityId": "i3",
+            "properties": {"rating": 4, "w": 0.5, "color": "red",
+                           "flag": True},
+            "eventTime": "2026-03-04T05:06:07.125+02:00",
+            "tags": ["a", "b"], "prId": "pr9",
+        }
+        outcome = parse_chunk([json.dumps(obj).encode()], 0)
+        assert not outcome.errors
+        ev = outcome.chunk.to_events()[0]
+        want = event_from_json(obj)
+        assert ev.event == want.event
+        assert ev.entity_id == want.entity_id
+        assert ev.target_entity_id == want.target_entity_id
+        assert ev.event_time == want.event_time
+        assert ev.event_id == "e1"
+        assert ev.tags == want.tags and ev.pr_id == want.pr_id
+        assert dict(ev.properties) == dict(want.properties)
+        assert isinstance(ev.properties["rating"], int)  # int-ness kept
+
+    def test_error_offsets_are_global_and_blank_lines_hold_position(self):
+        lines = [
+            _line(0, "a0"), b"", b"not json\n", _line(1, "a1"),
+            b'{"event":"","entityType":"u","entityId":"x"}\n',
+        ]
+        outcome = parse_chunk(lines, base_line=100)
+        assert [e["line"] for e in outcome.errors] == [102, 104]
+        assert outcome.row_lines == [100, 103]
+        assert outcome.received == 4  # blanks don't count
+
+    def test_joined_parse_cannot_be_smuggled(self):
+        # "1,2" is not valid JSON alone but would inject two array
+        # elements into a naive joined parse
+        lines = [_line(0, "s0"), b"1,2\n", _line(1, "s1")]
+        outcome = parse_chunk(lines, 0)
+        assert [e["line"] for e in outcome.errors] == [1]
+        assert outcome.row_lines == [0, 2]
+
+    def test_whitelist_rejects_with_403(self):
+        lines = [_line(0, "w0"), _line(1, "w1", event="buy")]
+        outcome = parse_chunk(lines, 0, allowed_events=frozenset({"buy"}))
+        assert len(outcome.row_lines) == 1
+        assert outcome.errors[0]["status"] == 403
+        assert outcome.errors[0]["line"] == 0
+
+    def test_overflowing_int_property_rides_the_residue(self):
+        """An integer beyond float range must not kill the stream — the
+        single route keeps it verbatim, so the bulk parser routes it to
+        the JSON residue."""
+        huge = 10 ** 400
+        outcome = parse_chunk(
+            [_line(0, "of0", properties={"x": huge, "rating": 1.5})], 0
+        )
+        assert not outcome.errors
+        ev = outcome.chunk.to_events()[0]
+        assert ev.properties["x"] == huge
+        assert ev.properties["rating"] == 1.5
+
+    def test_rows_without_event_id_are_stamped(self):
+        outcome = parse_chunk([_line(0), _line(1, "x1")], 0)
+        assert outcome.id_supplied == [False, True]
+        assert outcome.chunk.ids[1] == "x1"
+        assert outcome.chunk.ids[0] and outcome.chunk.ids[0] != "x1"
+
+
+class TestParseChunkWire:
+    def _wire(self, n=4, ids=None, **over):
+        obj = {
+            "event": ["rate"] * n,
+            "entityType": ["user"] * n,
+            "entityId": [f"u{i}" for i in range(n)],
+            "targetEntityType": ["item"] * n,
+            "targetEntityId": [f"i{i}" for i in range(n)],
+            "tUs": [1_700_000_000_000_000] * n,
+            "cUs": [1_700_000_000_000_000] * n,
+            "ids": ids if ids is not None else [f"w{i}" for i in range(n)],
+            "propf": {"rating": [float(i) for i in range(n)]},
+            "propint": {"rating": [False] * n},
+            "extra": [""] * n,
+        }
+        obj.update(over)
+        return json.dumps(obj).encode()
+
+    def test_valid_chunk_round_trips(self):
+        outcome = parse_chunk_wire(self._wire(4), base_row=10)
+        assert not outcome.errors
+        assert outcome.row_lines == [10, 11, 12, 13]
+        assert len(outcome.chunk) == 4
+        assert outcome.id_supplied == [True] * 4
+
+    def test_invalid_rows_dropped_with_row_offsets(self):
+        raw = self._wire(
+            4,
+            event=["rate", "", "$nope", "rate"],
+        )
+        outcome = parse_chunk_wire(raw, base_row=5)
+        assert len(outcome.chunk) == 2
+        assert sorted(e["line"] for e in outcome.errors) == [6, 7]
+        assert outcome.row_lines == [5, 8]
+
+    def test_whitelist_and_target_pairing(self):
+        raw = self._wire(
+            3,
+            event=["rate", "buy", "rate"],
+            targetEntityType=["item", "item", None],
+            targetEntityId=["i0", "i1", "i2"],
+        )
+        outcome = parse_chunk_wire(
+            raw, 0, allowed_events=frozenset({"rate"})
+        )
+        stats = {e["line"]: e["status"] for e in outcome.errors}
+        assert stats == {1: 403, 2: 400}
+
+    def test_null_ids_are_stamped_not_stringified(self):
+        outcome = parse_chunk_wire(self._wire(2, ids=["fixed", None]), 0)
+        assert not outcome.errors
+        assert outcome.chunk.ids[0] == "fixed"
+        assert outcome.chunk.ids[1] not in ("", "None")
+        assert outcome.id_supplied == [True, False]
+
+    def test_mismatched_columns_rejected_whole(self):
+        raw = self._wire(3, entityId=["u0", "u1"])
+        outcome = parse_chunk_wire(raw, 0)
+        assert len(outcome.chunk) == 0
+        assert "mismatched" in outcome.errors[0]["message"]
+
+    def test_propf_without_propint_twin_is_a_client_error(self):
+        """A propf key missing its propint twin must be rejected at
+        validation (400-class chunk error) — not crash the appender and
+        masquerade as a retryable server storage error."""
+        raw = self._wire(2, propint={})
+        outcome = parse_chunk_wire(raw, 0)
+        assert len(outcome.chunk) == 0
+        assert "mismatched" in outcome.errors[0]["message"]
+
+    def test_malformed_line_is_one_error(self):
+        outcome = parse_chunk_wire(b"{broken", 3)
+        assert len(outcome.chunk) == 0
+        assert outcome.errors[0]["line"] == 3
+
+    def test_wire_round_trip_preserves_chunk(self):
+        outcome = parse_chunk(
+            [_line(i, f"rt{i}") for i in range(5)], 0
+        )
+        back = EventChunk.from_wire(
+            json.loads(json.dumps(outcome.chunk.to_wire()))
+        )
+        assert back.ids == outcome.chunk.ids
+        assert back.event == outcome.chunk.event
+        assert np.array_equal(back.t_us, outcome.chunk.t_us)
+        assert set(back.propf) == set(outcome.chunk.propf)
+        got = [e for e in back.to_events()]
+        want = [e for e in outcome.chunk.to_events()]
+        assert [e.entity_id for e in got] == [e.entity_id for e in want]
+        assert [dict(e.properties) for e in got] == [
+            dict(e.properties) for e in want
+        ]
+
+
+# ---------------------------------------------------------------------------
+# Pipeline: staging, ordering, backpressure, failure containment
+# ---------------------------------------------------------------------------
+
+
+class TestIngestPipeline:
+    def test_results_stream_in_order_with_totals(self, tmp_path):
+        c = _columnar_client(tmp_path)
+        le = c.get_l_events()
+        le.init(APP)
+        pipe = IngestPipeline(le, APP, chunk_rows=64)
+        data = b"".join(_line(i, f"o{i:04d}") for i in range(500))
+        results: list[ChunkResult] = []
+        for off in range(0, len(data), 4096):
+            pipe.feed(data[off:off + 4096])
+            results.extend(pipe.poll())
+        results.extend(pipe.finish())
+        assert [r.seq for r in results] == list(range(len(results)))
+        assert pipe.summary() == {
+            "received": 500, "stored": 500, "duplicates": 0,
+            "invalid": 0, "chunks": len(results),
+        }
+        assert len(list(le.find(APP, limit=None))) == 500
+        c.close()
+
+    def test_trailing_line_without_newline_still_ingests(self, tmp_path):
+        c = _columnar_client(tmp_path)
+        le = c.get_l_events()
+        le.init(APP)
+        pipe = IngestPipeline(le, APP, chunk_rows=8)
+        pipe.feed(_line(0, "t0") + _line(1, "t1").rstrip(b"\n"))
+        list(pipe.finish())
+        assert pipe.stored == 2
+        c.close()
+
+    def test_storage_failure_fails_chunk_not_stream(self, tmp_path):
+        class Boom:
+            calls = 0
+
+            def ingest_chunk(self, chunk, app_id, channel_id=None):
+                Boom.calls += 1
+                if Boom.calls == 1:
+                    raise RuntimeError("disk on fire (secret path /x)")
+                return [(i, False) for i in chunk.ids]
+
+        pipe = IngestPipeline(Boom(), APP, chunk_rows=4)
+        pipe.feed(b"".join(_line(i, f"f{i}") for i in range(8)))
+        results = list(pipe.finish())
+        assert results[0].storage_error is not None
+        assert "secret" not in results[0].storage_error  # generic message
+        assert results[0].stored == 0
+        assert results[1].storage_error is None and results[1].stored == 4
+        assert pipe.stored == 4
+
+    def test_chunks_wire_mode_numbers_rows_globally(self, tmp_path):
+        c = _columnar_client(tmp_path)
+        le = c.get_l_events()
+        le.init(APP)
+        pipe = IngestPipeline(le, APP, wire="chunks")
+        w = TestParseChunkWire()
+        pipe.feed(w._wire(3) + b"\n" + w._wire(3, ids=["x0", "", "x2"],
+                                               entityId=["a", "", "c"]))
+        results = list(pipe.finish())
+        assert results[0].line_start == 0 and results[0].received == 3
+        assert results[1].line_start == 3
+        # row 4 (global) was invalid: empty entityId
+        assert [e["line"] for e in results[1].errors] == [4]
+        assert pipe.stored == 5
+        c.close()
+
+    def test_close_after_failure_raises_pipeline_error(self, tmp_path):
+        c = _columnar_client(tmp_path)
+        le = c.get_l_events()
+        le.init(APP)
+        pipe = IngestPipeline(le, APP)
+        pipe.close()
+        with pytest.raises(PipelineError):
+            pipe.feed(b"x\n")
+        c.close()
+
+    def test_split_lines_carries_partial(self):
+        lines, carry = split_lines(b"", b"a\nb\ncde")
+        assert lines == [b"a", b"b"] and carry == b"cde"
+        lines, carry = split_lines(carry, b"f\n")
+        assert lines == [b"cdef"] and carry == b""
+
+
+# ---------------------------------------------------------------------------
+# Columnar ingest_chunk: vectorized dedup + explicit-id segments
+# ---------------------------------------------------------------------------
+
+
+class TestColumnarIngestChunk:
+    def _chunk(self, ids, start=0):
+        lines = [_line(start + i, eid) for i, eid in enumerate(ids)]
+        return parse_chunk(lines, 0).chunk
+
+    def test_fresh_then_retransmit_then_mixed(self, tmp_path):
+        c = _columnar_client(tmp_path)
+        le = c.get_l_events()
+        le.init(APP)
+        r1 = le.ingest_chunk(self._chunk(["a", "b", "c"]), APP)
+        assert [d for _, d in r1] == [False, False, False]
+        r2 = le.ingest_chunk(self._chunk(["a", "b", "c"]), APP)
+        assert [d for _, d in r2] == [True, True, True]
+        r3 = le.ingest_chunk(self._chunk(["b", "d", "d"]), APP)
+        assert [d for _, d in r3] == [True, False, True]  # intra-chunk dup
+        ids = [e.event_id for e in le.find(APP, limit=None)]
+        assert sorted(ids) == ["a", "b", "c", "d"]
+        c.close()
+
+    def test_dedup_against_tail_and_batch_routes(self, tmp_path):
+        from predictionio_tpu.data.event import DataMap, Event
+
+        c = _columnar_client(tmp_path)
+        le = c.get_l_events()
+        le.init(APP)
+        le.insert_dedup(
+            Event(event="rate", entity_type="user", entity_id="x",
+                  event_id="tail-1"), APP,
+        )
+        res = le.ingest_chunk(self._chunk(["tail-1", "new-1"]), APP)
+        assert res == [("tail-1", True), ("new-1", False)]
+        # and the single route sees bulk ids right back
+        _, dup = le.insert_dedup(
+            Event(event="rate", entity_type="user", entity_id="y",
+                  event_id="new-1", properties=DataMap({})), APP,
+        )
+        assert dup
+        c.close()
+
+    def test_dedup_survives_restart_and_small_window(self, tmp_path):
+        c = _columnar_client(tmp_path, dedup_window="4")
+        le = c.get_l_events()
+        le.init(APP)
+        le.ingest_chunk(self._chunk([f"r{i}" for i in range(10)]), APP)
+        c.close()
+        c2 = _columnar_client(tmp_path, dedup_window="4")
+        le2 = c2.get_l_events()
+        res = le2.ingest_chunk(
+            self._chunk(["r0", "r9", "fresh"]), APP
+        )
+        assert res == [("r0", True), ("r9", True), ("fresh", False)]
+        c2.close()
+
+    def test_bulk_events_visible_to_find_columns_and_follower(self, tmp_path):
+        c = _columnar_client(tmp_path)
+        le = c.get_l_events()
+        le.init(APP)
+        pe = c.get_p_events()
+        _, cursor = pe.tail_follow(APP)  # anchor at end
+        le.ingest_chunk(self._chunk([f"v{i}" for i in range(6)]), APP)
+        events, cursor = pe.tail_follow(APP, cursor=cursor)
+        assert sorted(e.event_id for e in events) == [
+            f"v{i}" for i in range(6)
+        ]
+        cols = pe.find_columns(APP, prop="rating")
+        assert len(cols) == 6
+        assert not np.isnan(cols.prop).any()
+        c.close()
+
+    def test_point_get_and_delete_on_bulk_rows(self, tmp_path):
+        c = _columnar_client(tmp_path)
+        le = c.get_l_events()
+        le.init(APP)
+        le.ingest_chunk(self._chunk(["g1", "g2"]), APP)
+        ev = le.get("g1", APP)
+        assert ev is not None and ev.event_id == "g1"
+        assert le.delete("g1", APP)
+        assert le.get("g1", APP) is None
+        assert le.get("g2", APP) is not None
+        c.close()
+
+    def test_positional_at_ids_still_route(self, tmp_path):
+        c = _columnar_client(tmp_path)
+        le = c.get_l_events()
+        le.init(APP)
+        # positional segment via bulk_write (no ids column)
+        from predictionio_tpu.data.event import Event
+
+        le.bulk_write(
+            [Event(event="rate", entity_type="user", entity_id="p1")],
+            APP,
+        )
+        pos_id = next(le.find(APP, limit=None)).event_id
+        assert "@" in pos_id
+        res = le.ingest_chunk(self._chunk([pos_id, "normal"]), APP)
+        assert res[0] == (pos_id, True)  # routed positional lookup
+        assert res[1] == ("normal", False)
+        c.close()
+
+    def test_empty_chunk_is_noop(self, tmp_path):
+        c = _columnar_client(tmp_path)
+        le = c.get_l_events()
+        le.init(APP)
+        assert le.ingest_chunk(parse_chunk([], 0).chunk, APP) == []
+        c.close()
+
+
+# ---------------------------------------------------------------------------
+# Bounded dedup warm (satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestDedupWarmCap:
+    def test_warm_reads_only_the_capped_suffix(self, tmp_path):
+        from predictionio_tpu.data.event import Event
+
+        c = _columnar_client(tmp_path)
+        le = c.get_l_events()
+        le.init(APP)
+        le.insert_batch(
+            [
+                Event(event="rate", entity_type="user", entity_id="x",
+                      event_id=f"warm-{i:05d}")
+                for i in range(400)
+            ],
+            APP,
+        )
+        c.close()
+        # cap far below the tail size: warm must seek, not read whole
+        c2 = _columnar_client(tmp_path, dedup_warm_bytes="8192")
+        le2 = c2.get_l_events()
+        d = le2._stream_dir(APP, None)
+        lru = le2._recent_ids_for(d)
+        tail_bytes = os.path.getsize(os.path.join(d, "tail.jsonl"))
+        assert tail_bytes > 8192
+        assert 0 < len(lru) < 400  # suffix only
+        assert le2._recent_complete[d] is False
+        # correctness unchanged: old id (outside the warmed suffix) is
+        # still a duplicate via the exact fallback
+        _, dup = le2.insert_dedup(
+            Event(event="rate", entity_type="user", entity_id="x",
+                  event_id="warm-00000"), APP,
+        )
+        assert dup
+        report = c2.recovery_report()
+        assert report["dedupWarmMs"] >= 0.0
+        assert report["dedupWarmedStreams"] >= 1
+        c2.close()
+
+    def test_segment_ids_warm_within_budget_marks_complete(self, tmp_path):
+        c = _columnar_client(tmp_path)
+        le = c.get_l_events()
+        le.init(APP)
+        chunk = parse_chunk(
+            [_line(i, f"segwarm-{i}") for i in range(20)], 0
+        ).chunk
+        le.ingest_chunk(chunk, APP)
+        c.close()
+        c2 = _columnar_client(tmp_path)
+        le2 = c2.get_l_events()
+        d = le2._stream_dir(APP, None)
+        lru = le2._recent_ids_for(d)
+        assert "segwarm-3" in lru
+        assert le2._recent_complete[d] is True
+        c2.close()
+
+    def test_huge_positional_segment_keeps_window_complete(self, tmp_path):
+        """A store dominated by one big positional (write_columns)
+        segment must stay on the provably-complete fast path: positional
+        segments hold no client ids, so they cost no warm budget."""
+        c = _columnar_client(tmp_path)
+        le = c.get_l_events()
+        le.init(APP)
+        n = 5000
+        le.ingest_chunk(
+            parse_chunk([_line(i, f"wc-{i}") for i in range(50)], 0).chunk,
+            APP,
+        )
+        c._pevents.write_columns(
+            APP,
+            event="rate",
+            entity_type="user",
+            entity_codes=np.zeros(n, np.int32),
+            entity_vocab=np.asarray(["u0"]),
+            event_time_us=np.full(n, 1_700_000_000_000_000, np.int64),
+        )
+        c.close()
+        # warm budget far below the positional segment's size
+        seg_bytes = max(
+            os.path.getsize(p)
+            for p in __import__("glob").glob(
+                str(tmp_path / "cols" / "pio_events" / "*" / "*" / "seg-*")
+            )
+        )
+        c2 = _columnar_client(
+            tmp_path, dedup_warm_bytes=str(max(4096, seg_bytes // 4))
+        )
+        le2 = c2.get_l_events()
+        d = le2._stream_dir(APP, None)
+        lru = le2._recent_ids_for(d)
+        assert "wc-7" in lru
+        assert le2._recent_complete[d] is True, (
+            "positional segment burned the warm budget"
+        )
+        c2.close()
+
+    def test_warm_stats_on_event_server(self, service_env):
+        Storage, app_id = service_env
+        from predictionio_tpu.api import EventService
+
+        svc = EventService(stats=True)
+        resp = svc.get_stats({"accessKey": "bk"})
+        assert resp.status == 200
+        assert "dedupWarmMs" in resp.body["dedup"]
+
+
+# ---------------------------------------------------------------------------
+# The bulk route over dispatch + real HTTP
+# ---------------------------------------------------------------------------
+
+
+class TestBulkRoute:
+    def _bulk(self, svc, payload: bytes, params=None, headers=None):
+        resp = svc.dispatch(
+            "POST", "/events/bulk.json", params or {"accessKey": "bk"},
+            headers=headers or {"Content-Type": "application/x-ndjson"},
+            stream=io.BytesIO(payload),
+        )
+        if not hasattr(resp, "chunks"):
+            return resp, None, None
+        lines = [
+            json.loads(ln)
+            for ln in b"".join(resp.chunks).split(b"\n")
+            if ln.strip()
+        ]
+        return resp, lines[:-1], lines[-1]
+
+    def test_streams_per_chunk_statuses_and_summary(self, service_env):
+        from predictionio_tpu.api import EventService
+
+        svc = EventService()
+        payload = b"".join(_line(i, f"rt{i:04d}") for i in range(300))
+        _, statuses, summary = self._bulk(
+            svc, payload, {"accessKey": "bk", "chunkRows": "100"}
+        )
+        assert len(statuses) == 3
+        assert [s["chunk"] for s in statuses] == [0, 1, 2]
+        assert [s["lineStart"] for s in statuses] == [0, 100, 200]
+        assert summary["done"] and summary["ok"]
+        assert summary["stored"] == 300 and summary["received"] == 300
+
+    def test_duplicate_lines_reported_like_batch_route(self, service_env):
+        from predictionio_tpu.api import EventService
+
+        svc = EventService()
+        payload = b"".join(_line(i, f"dl{i}") for i in range(5))
+        self._bulk(svc, payload)
+        # retransmit 3 of them mixed with fresh — per-item duplicate
+        # verdicts must match what the batch route answers for the same
+        # ids (the "consistently" satellite)
+        mixed = (
+            _line(0, "dl0") + _line(9, "fresh-9") + _line(2, "dl2")
+            + _line(3, "dl3")
+        )
+        _, statuses, summary = self._bulk(svc, mixed)
+        assert summary["duplicates"] == 3 and summary["stored"] == 1
+        assert statuses[0]["duplicateLines"] == [0, 2, 3]
+        batch_resp = svc.dispatch(
+            "POST", "/batch/events.json", {"accessKey": "bk"},
+            body=[json.loads(_line(0, "dl0")),
+                  json.loads(_line(1, "dl1"))],
+        )
+        flags = [bool(item.get("duplicate")) for item in batch_resp.body]
+        assert flags == [True, True]
+
+    def test_error_offsets_and_forbidden_events(self, service_env):
+        Storage, app_id = service_env
+        from predictionio_tpu.api import EventService
+        from predictionio_tpu.data.storage.base import AccessKey
+
+        Storage.get_meta_data_access_keys().insert(
+            AccessKey(key="narrow", appid=app_id, events=("buy",))
+        )
+        svc = EventService()
+        payload = (
+            _line(0, "x0")  # rate: forbidden for this key
+            + b"garbage\n"
+            + _line(1, "x1", event="buy")
+        )
+        _, statuses, summary = self._bulk(
+            svc, payload, {"accessKey": "narrow"}
+        )
+        errs = {e["line"]: e["status"] for e in statuses[0]["errors"]}
+        assert errs == {0: 403, 1: 400}
+        assert summary["stored"] == 1 and summary["invalid"] == 2
+
+    def test_auth_errors_never_touch_the_stream(self, service_env):
+        from predictionio_tpu.api import EventService
+
+        svc = EventService()
+        resp, _, _ = self._bulk(svc, b"junk", {"accessKey": "wrong"})
+        assert resp.status == 401
+
+    def test_unsupported_encoding_rejected(self, service_env):
+        from predictionio_tpu.api import EventService
+
+        svc = EventService()
+        resp = svc.dispatch(
+            "POST", "/events/bulk.json", {"accessKey": "bk"},
+            headers={"Content-Encoding": "br"},
+            stream=io.BytesIO(b""),
+        )
+        assert resp.status == 415
+
+    def test_single_and_batch_routes_untouched_by_bulk(self, service_env):
+        """Strictly-additive guard: the byte shapes of the single/batch
+        responses are identical whether or not the bulk route has ever
+        run in the process."""
+        from predictionio_tpu.api import EventService
+
+        svc = EventService()
+        single = svc.dispatch(
+            "POST", "/events.json", {"accessKey": "bk"},
+            body=json.loads(_line(0, "add-1")),
+        )
+        batch = svc.dispatch(
+            "POST", "/batch/events.json", {"accessKey": "bk"},
+            body=[json.loads(_line(1, "add-2"))],
+        )
+        before = (single.status, single.json_bytes(), batch.status,
+                  json.loads(batch.json_bytes())[0]["status"])
+        self._bulk(svc, b"".join(_line(i, f"bulkrun{i}") for i in range(3)))
+        single2 = svc.dispatch(
+            "POST", "/events.json", {"accessKey": "bk"},
+            body=json.loads(_line(0, "add-1")),
+        )
+        assert single2.status == 201 and single2.body["duplicate"] is True
+        assert before[0] == 201
+        assert json.loads(before[1]) == {"eventId": "add-1"}
+
+    def test_real_http_chunked_gzip_and_keepalive(self, service_env):
+        from predictionio_tpu.api import EventService
+        from predictionio_tpu.api.http import start_background
+
+        svc = EventService()
+        server, _ = start_background(svc.dispatch, port=0)
+        try:
+            port = server.server_address[1]
+            payload = b"".join(_line(i, f"gz{i:04d}") for i in range(200))
+            gz = gzip.compress(payload)
+            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+            conn.putrequest(
+                "POST", "/events/bulk.json?accessKey=bk&chunkRows=64"
+            )
+            conn.putheader("Content-Encoding", "gzip")
+            conn.putheader("Transfer-Encoding", "chunked")
+            conn.endheaders()
+            for off in range(0, len(gz), 512):
+                piece = gz[off:off + 512]
+                conn.send(f"{len(piece):X}\r\n".encode() + piece + b"\r\n")
+            conn.send(b"0\r\n\r\n")
+            resp = conn.getresponse()
+            assert resp.status == 200
+            lines = [
+                json.loads(ln)
+                for ln in resp.read().split(b"\n")
+                if ln.strip()
+            ]
+            assert lines[-1]["stored"] == 200
+            # keep-alive survives the streamed exchange
+            conn.request(
+                "POST", "/events.json?accessKey=bk",
+                body=_line(0, "after-bulk").rstrip(b"\n"),
+                headers={"Content-Type": "application/json"},
+            )
+            r2 = conn.getresponse()
+            assert r2.status == 201
+            r2.read()
+            conn.close()
+        finally:
+            server.shutdown()
+            server.server_close()
+
+    def test_malformed_chunked_framing_closes_the_connection(
+        self, service_env
+    ):
+        """A bad chunk-size line leaves unknown bytes on the wire — the
+        server must answer a stream-level error AND hang up instead of
+        parsing the leftover bytes as a next request (desync)."""
+        import socket as _socket
+
+        from predictionio_tpu.api import EventService
+        from predictionio_tpu.api.http import start_background
+
+        svc = EventService()
+        server, _ = start_background(svc.dispatch, port=0)
+        try:
+            port = server.server_address[1]
+            with _socket.create_connection(("127.0.0.1", port), 10) as s:
+                s.sendall(
+                    b"POST /events/bulk.json?accessKey=bk HTTP/1.1\r\n"
+                    b"Host: x\r\nContent-Type: application/x-ndjson\r\n"
+                    b"Transfer-Encoding: chunked\r\n\r\n"
+                    b"ZZZ\r\n"  # malformed size line
+                    b"GET /healthz HTTP/1.1\r\n\r\n"  # smuggle attempt
+                )
+                s.settimeout(10)
+                data = b""
+                while True:
+                    try:
+                        piece = s.recv(65536)
+                    except OSError:
+                        break
+                    if not piece:
+                        break
+                    data += piece
+            text = data.decode(errors="replace")
+            assert '"ok":false' in text.replace(" ", ""), text
+            # exactly ONE response came back: the smuggled request after
+            # the bad framing was never served
+            assert text.count("HTTP/1.1 200") <= 1
+            assert "healthz" not in text
+        finally:
+            server.shutdown()
+            server.server_close()
+
+    def test_truncated_chunked_upload_ends_ok_false(self, service_env):
+        """A connection that dies before the terminating 0-chunk must
+        NOT be acked ok:true — the un-sent half would silently vanish."""
+        import socket as _socket
+
+        from predictionio_tpu.api import EventService
+        from predictionio_tpu.api.http import start_background
+
+        svc = EventService()
+        server, _ = start_background(svc.dispatch, port=0)
+        try:
+            port = server.server_address[1]
+            piece = _line(0, "tc0") + _line(1, "tc1")
+            with _socket.create_connection(("127.0.0.1", port), 10) as s:
+                s.sendall(
+                    b"POST /events/bulk.json?accessKey=bk HTTP/1.1\r\n"
+                    b"Host: x\r\nContent-Type: application/x-ndjson\r\n"
+                    b"Transfer-Encoding: chunked\r\n\r\n"
+                    + f"{len(piece):X}\r\n".encode() + piece + b"\r\n"
+                )
+                s.shutdown(_socket.SHUT_WR)  # die before the 0-chunk
+                s.settimeout(10)
+                data = b""
+                while True:
+                    try:
+                        p = s.recv(65536)
+                    except OSError:
+                        break
+                    if not p:
+                        break
+                    data += p
+            text = data.replace(b" ", b"")
+            assert b'"ok":false' in text, data
+            assert b'"error"' in text
+        finally:
+            server.shutdown()
+            server.server_close()
+
+    def test_truncated_gzip_upload_ends_ok_false(self, service_env):
+        from predictionio_tpu.api import EventService
+
+        svc = EventService()
+        payload = b"".join(_line(i, f"tg{i}") for i in range(50))
+        cut = gzip.compress(payload)[:-20]  # drop the trailer + tail
+        resp = svc.dispatch(
+            "POST", "/events/bulk.json", {"accessKey": "bk"},
+            headers={"Content-Type": "application/x-ndjson",
+                     "Content-Encoding": "gzip"},
+            stream=io.BytesIO(cut),
+        )
+        lines = [
+            json.loads(ln)
+            for ln in b"".join(resp.chunks).split(b"\n")
+            if ln.strip()
+        ]
+        assert lines[-1]["ok"] is False
+        assert "gzip" in lines[-1]["error"]
+
+    def test_chunks_wire_content_type(self, service_env):
+        _, app_id = service_env
+        from predictionio_tpu.api import EventService
+
+        svc = EventService()
+        w = TestParseChunkWire()
+        resp = svc.dispatch(
+            "POST", "/events/bulk.json", {"accessKey": "bk"},
+            headers={"Content-Type": "application/x-pio-chunks"},
+            stream=io.BytesIO(w._wire(6, ids=[f"cw{i}" for i in range(6)])),
+        )
+        lines = [
+            json.loads(ln)
+            for ln in b"".join(resp.chunks).split(b"\n")
+            if ln.strip()
+        ]
+        assert lines[-1]["stored"] == 6
+        ids = {
+            e.event_id
+            for e in Storage.get_l_events().find(app_id, limit=None)
+        }
+        assert {f"cw{i}" for i in range(6)} <= ids
+
+    def test_bulk_counters_on_stats(self, service_env):
+        from predictionio_tpu.api import EventService
+
+        svc = EventService(stats=True)
+        self._bulk(svc, b"".join(_line(i, f"st{i}") for i in range(10)))
+        stats = svc.get_stats({"accessKey": "bk"}).body
+        assert stats["bulk"]["requests"] == 1
+        assert stats["bulk"]["stored"] == 10
+        assert stats["bulk"]["bytesIn"] > 0
+        assert stats["dedup"]["misses"] >= 10  # supplied fresh ids
+
+
+# ---------------------------------------------------------------------------
+# Remote storage RPC
+# ---------------------------------------------------------------------------
+
+
+class TestRemoteIngestChunk:
+    def _pair(self, tmp_path):
+        from predictionio_tpu.data.storage.remote import StorageRpcService
+        from predictionio_tpu.api.http import start_background
+        from predictionio_tpu.data.storage import remote as remote_mod
+
+        backing = _columnar_client(tmp_path)
+        service = StorageRpcService(client=backing)
+        server, _ = start_background(service.dispatch, port=0)
+        port = server.server_address[1]
+        client = remote_mod.StorageClient(
+            StorageClientConfig(
+                "R", "remote", {"hosts": "127.0.0.1", "ports": str(port)}
+            )
+        )
+        return backing, server, client
+
+    def test_chunk_rpc_round_trip_with_dedup(self, tmp_path):
+        backing, server, client = self._pair(tmp_path)
+        try:
+            le = client.get_l_events()
+            le.init(APP)
+            chunk = parse_chunk(
+                [_line(i, f"rpc{i}") for i in range(4)], 0
+            ).chunk
+            res = le.ingest_chunk(chunk, APP)
+            assert res == [(f"rpc{i}", False) for i in range(4)]
+            res2 = le.ingest_chunk(chunk, APP)
+            assert res2 == [(f"rpc{i}", True) for i in range(4)]
+            stored = list(backing.get_l_events().find(APP, limit=None))
+            assert sorted(e.event_id for e in stored) == [
+                f"rpc{i}" for i in range(4)
+            ]
+            props = [dict(e.properties) for e in stored]
+            assert all("rating" in p for p in props)
+        finally:
+            server.shutdown()
+            server.server_close()
+
+    def test_legacy_server_fallback(self, tmp_path):
+        """A server that predates the bulk SPI answers 'unknown method';
+        the client must fall back to the decoded batch-dedup path."""
+        backing, server, client = self._pair(tmp_path)
+        try:
+            le = client.get_l_events()
+            le.init(APP)
+            rpc = le._rpc
+            real_call = rpc.call
+
+            def call(role, method, args, **kw):
+                if method == "ingest_chunk":
+                    from predictionio_tpu.data.storage.base import (
+                        StorageError,
+                    )
+
+                    raise StorageError("unknown method 'l_events.ingest_chunk'")
+                return real_call(role, method, args, **kw)
+
+            rpc.call = call
+            chunk = parse_chunk(
+                [_line(i, f"fb{i}") for i in range(3)], 0
+            ).chunk
+            res = le.ingest_chunk(chunk, APP)
+            assert res == [(f"fb{i}", False) for i in range(3)]
+            res2 = le.ingest_chunk(chunk, APP)
+            assert [d for _, d in res2] == [True, True, True]
+        finally:
+            server.shutdown()
+            server.server_close()
+
+
+# ---------------------------------------------------------------------------
+# Background compaction scheduler
+# ---------------------------------------------------------------------------
+
+
+class TestCompactionScheduler:
+    def _store_with_tail(self, tmp_path, n=20):
+        from predictionio_tpu.data.event import Event
+
+        c = _columnar_client(tmp_path)
+        le = c.get_l_events()
+        le.init(APP)
+        le.insert_batch(
+            [
+                Event(event="rate", entity_type="user", entity_id="x",
+                      event_id=f"sch-{i}")
+                for i in range(n)
+            ],
+            APP,
+        )
+        return c, le
+
+    def test_tail_bytes_watermark_triggers_compaction(self, tmp_path):
+        from predictionio_tpu.data.storage.compaction import (
+            CompactionConfig,
+            CompactionScheduler,
+        )
+
+        c, le = self._store_with_tail(tmp_path)
+        sched = CompactionScheduler(
+            le, CompactionConfig(tail_bytes_high=64, min_interval_s=0.0)
+        )
+        assert sched.sweep_once() == 1
+        d = le._stream_dir(APP, None)
+        assert os.path.getsize(os.path.join(d, "tail.jsonl")) == 0
+        assert le._compactions(d) == 1
+        # below watermark now: nothing to do
+        assert sched.sweep_once() == 0
+        stats = sched.to_json()
+        assert stats["compactions"] == 1 and stats["eventsMoved"] == 20
+        c.close()
+
+    def test_rate_limit_holds_between_compactions(self, tmp_path):
+        from predictionio_tpu.data.event import Event
+        from predictionio_tpu.data.storage.compaction import (
+            CompactionConfig,
+            CompactionScheduler,
+        )
+
+        c, le = self._store_with_tail(tmp_path)
+        sched = CompactionScheduler(
+            le, CompactionConfig(tail_bytes_high=64, min_interval_s=60.0)
+        )
+        assert sched.sweep_once() == 1
+        le.insert_batch(
+            [Event(event="rate", entity_type="user", entity_id="y",
+                   event_id=f"sch2-{i}") for i in range(20)],
+            APP,
+        )
+        assert sched.sweep_once() == 0  # rate-limited
+        c.close()
+
+    def test_dead_tombstone_watermark(self, tmp_path):
+        from predictionio_tpu.data.storage.compaction import (
+            CompactionConfig,
+            CompactionScheduler,
+        )
+
+        c, le = self._store_with_tail(tmp_path, n=10)
+        for i in range(6):
+            le.delete(f"sch-{i}", APP)
+        sched = CompactionScheduler(
+            le,
+            CompactionConfig(
+                tail_bytes_high=10**9, dead_tombstones_high=5,
+                min_interval_s=0.0,
+            ),
+        )
+        assert sched.sweep_once() == 1
+        assert len(list(le.find(APP, limit=None))) == 4
+        c.close()
+
+    def test_background_thread_start_stop(self, tmp_path):
+        from predictionio_tpu.data.storage.compaction import (
+            CompactionConfig,
+            CompactionScheduler,
+        )
+
+        c, le = self._store_with_tail(tmp_path)
+        sched = CompactionScheduler(
+            le,
+            CompactionConfig(
+                interval_s=0.05, tail_bytes_high=64, min_interval_s=0.0
+            ),
+        )
+        sched.start()
+        deadline = time.monotonic() + 5.0
+        d = le._stream_dir(APP, None)
+        while time.monotonic() < deadline:
+            if le._compactions(d) >= 1:
+                break
+            time.sleep(0.02)
+        sched.stop()
+        assert le._compactions(d) >= 1
+        assert sched.to_json()["running"] is False
+        c.close()
+
+    def test_dedup_survives_scheduled_compaction(self, tmp_path):
+        from predictionio_tpu.data.storage.compaction import (
+            CompactionConfig,
+            CompactionScheduler,
+        )
+
+        c, le = self._store_with_tail(tmp_path)
+        CompactionScheduler(
+            le, CompactionConfig(tail_bytes_high=1, min_interval_s=0.0)
+        ).sweep_once()
+        chunk = parse_chunk([_line(0, "sch-3"), _line(1, "post-c")], 0).chunk
+        res = le.ingest_chunk(chunk, APP)
+        assert res == [("sch-3", True), ("post-c", False)]
+        c.close()
+
+
+# ---------------------------------------------------------------------------
+# pio import over the pipeline
+# ---------------------------------------------------------------------------
+
+
+class TestPipelinedImport:
+    def test_import_counts_and_dedups_on_rerun(self, service_env, tmp_path):
+        Storage, app_id = service_env
+        from predictionio_tpu.tools.commands import import_events
+
+        path = tmp_path / "events.jsonl"
+        with open(path, "w") as f:
+            for i in range(120):
+                f.write(_line(i, f"imp{i:04d}").decode())
+        messages: list[str] = []
+        n = import_events("bulkapp", str(path), out=messages.append)
+        assert n == 120
+        assert "Imported 120 events" in messages[0]
+        # re-run: idempotent via eventIds
+        n2 = import_events("bulkapp", str(path), out=messages.append)
+        assert n2 == 120
+        assert "duplicate" in messages[1]
+        ids = [
+            e.event_id
+            for e in Storage.get_l_events().find(app_id, limit=None)
+        ]
+        assert len(ids) == 120 and len(set(ids)) == 120
+
+    def test_first_bad_line_aborts_with_position(self, service_env, tmp_path):
+        from predictionio_tpu.data.storage.base import StorageError
+        from predictionio_tpu.tools.commands import import_events
+
+        path = tmp_path / "bad.jsonl"
+        with open(path, "w") as f:
+            f.write(_line(0, "ok0").decode())
+            f.write("THIS IS NOT JSON\n")
+            f.write(_line(1, "ok1").decode())
+        with pytest.raises(StorageError) as err:
+            import_events("bulkapp", str(path))
+        assert f"{path}:2:" in str(err.value)
+
+    def test_import_without_ids_never_dedups(self, service_env, tmp_path):
+        Storage, app_id = service_env
+        from predictionio_tpu.tools.commands import import_events
+
+        path = tmp_path / "noids.jsonl"
+        with open(path, "w") as f:
+            for i in range(10):
+                f.write(_line(i).decode())
+        import_events("bulkapp", str(path))
+        import_events("bulkapp", str(path))
+        assert (
+            len(list(Storage.get_l_events().find(app_id, limit=None))) == 20
+        )
+
+
+# ---------------------------------------------------------------------------
+# Strictly-additive / opt-in CI guards
+# ---------------------------------------------------------------------------
+
+
+class TestBulkGuards:
+    def test_default_import_path_stays_lazy(self):
+        """Constructing an EventService (or importing the api package)
+        must not pull in the bulk pipeline or numpy-heavy parse code —
+        the default event-server path is byte-identical to a build
+        without the subsystem until the first bulk request."""
+        code = (
+            "import sys\n"
+            "import predictionio_tpu.api.service as s\n"
+            "svc = s.EventService()\n"
+            "assert 'predictionio_tpu.data.ingest' not in sys.modules, "
+            "'bulk pipeline imported on the default path'\n"
+            "assert 'predictionio_tpu.data.storage.compaction' not in "
+            "sys.modules\n"
+            "import threading\n"
+            "names = {t.name for t in threading.enumerate()}\n"
+            "assert not any(n.startswith('pio-ingest') or "
+            "n.startswith('pio-compact') for n in names), names\n"
+            "print('LAZY-OK')\n"
+        )
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            cwd=REPO, capture_output=True, text=True, timeout=120,
+        )
+        assert proc.returncode == 0, proc.stderr
+        assert "LAZY-OK" in proc.stdout
+
+    def test_compaction_scheduler_defaults_off(self):
+        from predictionio_tpu.tools.console import build_parser
+
+        args = build_parser().parse_args(["eventserver"])
+        assert args.compact_interval_s == 0.0
+        # and no scheduler object exists on a default service
+        from predictionio_tpu.api import EventService
+
+        assert EventService().compaction_scheduler is None
+
+    def test_chaos_cli_carries_bulk_events(self):
+        from predictionio_tpu.tools.console import build_parser
+
+        args = build_parser().parse_args(["chaos-ingest"])
+        assert args.bulk_events == 1000
+
+    def test_stream_routes_registered(self):
+        from predictionio_tpu.api import EventService
+
+        assert ("POST", "/events/bulk.json") in EventService.stream_routes

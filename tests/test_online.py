@@ -370,6 +370,144 @@ class TestTailFollower:
 # ---------------------------------------------------------------------------
 
 
+class TestFollowerUnderSchedulerAndBulk:
+    """ISSUE 12 satellite: the tail follower stays exactly-once while
+    the BACKGROUND compaction scheduler bumps generations underneath it
+    and the bulk route lands explicit-id chunk segments concurrently —
+    the write-side pressure the cursor's re-anchor was built for."""
+
+    def _chunk(self, ids):
+        from predictionio_tpu.data.ingest import parse_chunk
+
+        lines = [
+            (
+                json.dumps(
+                    {
+                        "eventId": eid,
+                        "event": "rate",
+                        "entityType": "user",
+                        "entityId": f"u{k % 5}",
+                        "targetEntityType": "item",
+                        "targetEntityId": f"i{k % 9}",
+                        "properties": {"rating": float(1 + k % 5)},
+                    }
+                )
+                + "\n"
+            ).encode()
+            for k, eid in enumerate(ids)
+        ]
+        return parse_chunk(lines, 0).chunk
+
+    def test_deterministic_interleave_is_exactly_once(self, columnar_env):
+        from predictionio_tpu.data.storage.compaction import (
+            CompactionConfig,
+            CompactionScheduler,
+        )
+
+        app_id = _new_app(Storage, "schedapp")
+        le = Storage.get_l_events()
+        pe = Storage.get_p_events()
+        le.init(app_id)
+        _, cursor = pe.tail_follow(app_id)  # anchor at end
+        sched = CompactionScheduler(
+            le, CompactionConfig(tail_bytes_high=1, min_interval_s=0.0)
+        )
+        expected: list[str] = []
+        seen: list[str] = []
+        for rnd in range(12):
+            tail_ids = [f"t{rnd}-{i}" for i in range(4)]
+            for i, eid in enumerate(tail_ids):
+                le.insert_dedup(_rate(i, i, 3.0, eid=eid), app_id)
+            bulk_ids = [f"b{rnd}-{i}" for i in range(6)]
+            le.ingest_chunk(self._chunk(bulk_ids), app_id)
+            expected += tail_ids + bulk_ids
+            if rnd % 3 == 1:
+                assert sched.sweep_once() >= 1  # generation bump
+            events, cursor = pe.tail_follow(app_id, cursor=cursor)
+            seen += [e.event_id for e in events]
+        events, cursor = pe.tail_follow(app_id, cursor=cursor)
+        seen += [e.event_id for e in events]
+        assert sorted(seen) == sorted(expected)  # no loss, no dups
+        assert sched.to_json()["compactions"] >= 4
+
+    def test_threaded_writers_and_scheduler_stay_exactly_once(
+        self, columnar_env
+    ):
+        import threading
+
+        from predictionio_tpu.data.storage.compaction import (
+            CompactionConfig,
+            CompactionScheduler,
+        )
+
+        app_id = _new_app(Storage, "schedapp2")
+        le = Storage.get_l_events()
+        pe = Storage.get_p_events()
+        le.init(app_id)
+        _, cursor = pe.tail_follow(app_id)
+        sched = CompactionScheduler(
+            le,
+            CompactionConfig(
+                interval_s=0.02, tail_bytes_high=256, min_interval_s=0.0
+            ),
+        )
+        stop = threading.Event()
+        written: list[str] = []
+        lock = threading.Lock()
+
+        def tail_writer():
+            i = 0
+            while not stop.is_set() and i < 150:
+                eid = f"tw-{i:04d}"
+                le.insert_dedup(_rate(i, i, 2.0, eid=eid), app_id)
+                with lock:
+                    written.append(eid)
+                i += 1
+                time.sleep(0.002)
+
+        def bulk_writer():
+            i = 0
+            while not stop.is_set() and i < 30:
+                ids = [f"bw-{i:03d}-{j}" for j in range(8)]
+                le.ingest_chunk(self._chunk(ids), app_id)
+                with lock:
+                    written.extend(ids)
+                i += 1
+                time.sleep(0.005)
+
+        threads = [
+            threading.Thread(target=tail_writer, daemon=True),
+            threading.Thread(target=bulk_writer, daemon=True),
+        ]
+        sched.start()
+        for t in threads:
+            t.start()
+        seen: list[str] = []
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            events, cursor = pe.tail_follow(app_id, cursor=cursor)
+            seen += [e.event_id for e in events]
+            if all(not t.is_alive() for t in threads):
+                break
+            time.sleep(0.01)
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        sched.stop()
+        # final drain polls (a compaction may land between the last poll
+        # and the writer exit)
+        for _ in range(3):
+            events, cursor = pe.tail_follow(app_id, cursor=cursor)
+            seen += [e.event_id for e in events]
+        with lock:
+            want = sorted(written)
+        assert sorted(seen) == want, (
+            f"lost={set(want) - set(seen)} dup="
+            f"{[e for e in seen if seen.count(e) > 1][:5]}"
+        )
+        assert sched.to_json()["compactions"] >= 1
+
+
 class TestFoldinSolver:
     def test_explicit_matches_normal_equations(self):
         from predictionio_tpu.online.foldin import foldin_rows
