@@ -28,6 +28,13 @@ property checkable here:
   ``jax.shard_map``) AND its replication-check rename (``check_rep`` ->
   ``check_vma``), so a direct import/attribute use in a kernel quietly
   re-breaks jax<0.6 hosts the moment it needs either knob.
+* ``PIO305`` raw int8 quantization outside ``ops/quant.py``: ONE
+  quantization rule lives in ONE module (the same containment contract
+  PIO304 enforces for shard_map) — the rounding mode, the zero-row
+  guard, and the re-quantize-on-scatter rule must agree everywhere, or
+  the fold-in path writes rows the serving kernels decode differently.
+  ``.astype(jnp.int8)``, ``dtype=...int8`` and bare ``np.int8``/
+  ``jnp.int8`` references anywhere else in the scope are findings.
 """
 
 from __future__ import annotations
@@ -320,3 +327,64 @@ def check_raw_shard_map(ctx: FileContext) -> Iterator[Finding]:
                 "jax<0.6 hosts working (import home + check_rep/"
                 "check_vma rename both live there)",
             )
+
+
+#: the one module allowed to construct int8 quantized state — the
+#: single rounding rule every code/scale pair in the repo shares
+_QUANT_MODULE = "predictionio_tpu/ops/quant.py"
+
+_INT8_DTYPE_ATTRS = frozenset({"numpy.int8", "jax.numpy.int8", "jax.int8"})
+
+
+def _is_int8_expr(ctx: FileContext, node: ast.AST) -> bool:
+    """Does this expression name the int8 dtype — ``jnp.int8``/
+    ``np.int8`` (any alias) or the ``"int8"`` string literal?"""
+    if isinstance(node, ast.Constant) and node.value == "int8":
+        return True
+    dotted = ctx.dotted_name(node)
+    return dotted in _INT8_DTYPE_ATTRS
+
+
+@rule(
+    "PIO305",
+    "raw-int8-quantization",
+    "int8 quantization constructed outside ops/quant.py",
+)
+def check_raw_int8(ctx: FileContext) -> Iterator[Finding]:
+    if not _in_scope(ctx) and not ctx.rel_path.startswith(
+        "predictionio_tpu/workflow/"
+    ):
+        return
+    if ctx.rel_path.replace("\\", "/") == _QUANT_MODULE:
+        return
+    msg = (
+        "{what}: int8 quantized state must be constructed through "
+        "predictionio_tpu.ops.quant (one rounding rule, one zero-row "
+        "guard, one re-quantize-on-scatter contract — the fold-in and "
+        "serving kernels must agree on all three)"
+    )
+    seen: set[int] = set()
+    for node in ast.walk(ctx.tree):
+        hit = None
+        if isinstance(node, ast.Call):
+            # x.astype(int8) / x.astype("int8") / x.view(...)-style casts
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "astype"
+                and node.args
+                and _is_int8_expr(ctx, node.args[0])
+            ):
+                hit = ".astype(int8)"
+            else:
+                # dtype=int8 keyword on any constructor (zeros, asarray,
+                # empty, full, np.dtype, device_put-adjacent helpers)
+                for kw in node.keywords:
+                    if kw.arg == "dtype" and _is_int8_expr(ctx, kw.value):
+                        hit = "dtype=int8"
+                        break
+        elif isinstance(node, ast.Attribute):
+            if ctx.dotted_name(node) in _INT8_DTYPE_ATTRS:
+                hit = ctx.dotted_name(node)
+        if hit is not None and node.lineno not in seen:
+            seen.add(node.lineno)
+            yield ctx.finding("PIO305", node, msg.format(what=hit))

@@ -90,21 +90,30 @@ class IVFIndex(NamedTuple):
     """Cluster-major retrieval state. Array fields are pytree children;
     the int metadata travels in the treedef so it stays STATIC under jit
     (the query kernel's shapes and the sentinel id are compile-time
-    constants)."""
+    constants).
 
-    centroids: Any  # [nlist, K] f32
-    slabs: Any  # [nlist, W, K] f32 — per-cluster factor slabs, zero-padded
+    With ``--quantize int8`` (``build_ivf(quantize=True)``) ``slabs``
+    holds int8 codes and ``slab_scales`` the per-lane f32 scales
+    (``ops/quant``'s one rounding rule) — per-probe gather bytes drop
+    ~4x, which is the dominating cost of the probe stage on
+    bandwidth-bound hosts (PR 6's measurement). ``slab_scales is None``
+    means the classic f32 layout; the treedef difference keeps the two
+    modes on separate compiled programs."""
+
+    centroids: Any  # [nlist, K] f32 (ALWAYS f32 — stage 1 stays exact)
+    slabs: Any  # [nlist, W, K] f32 (or int8 codes) — zero-padded slabs
     slab_ids: Any  # [nlist, W] int32 — item id per slab row; pad = num_items
     num_items: int
     nlist: int
     slab_width: int
+    slab_scales: Any = None  # [nlist, W] f32 per-lane scales (int8 mode)
 
 
 jax.tree_util.register_pytree_node(
     IVFIndex,
-    lambda x: ((x.centroids, x.slabs, x.slab_ids),
+    lambda x: ((x.centroids, x.slabs, x.slab_ids, x.slab_scales),
                (x.num_items, x.nlist, x.slab_width)),
-    lambda aux, ch: IVFIndex(*ch, *aux),
+    lambda aux, ch: IVFIndex(ch[0], ch[1], ch[2], *aux, ch[3]),
 )
 
 
@@ -236,6 +245,7 @@ def build_ivf(
     seed: int = 0,
     iters: int = 8,
     balance: float = 1.3,
+    quantize: bool = False,
 ) -> tuple[IVFIndex, dict]:
     """Partition ``item_factors [I, K]`` into ``nlist`` clusters and lay
     them out cluster-major. ``nlist <= 0`` picks :func:`auto_nlist`.
@@ -307,13 +317,26 @@ def build_ivf(
     slabs = np.zeros((nlist, slab_width, dim), dtype=np.float32)
     slabs[assign[order], lane] = x[order]
 
+    if quantize:
+        # int8 slab storage (--quantize int8): k-means and the reorder
+        # above ran on the f32 values; only the SERVED layout quantizes
+        # (per-lane codes + scales — ops/quant owns the rounding rule)
+        from predictionio_tpu.ops import quant
+
+        codes, lane_scales = quant.quantize_slabs(slabs)
+        slab_arr = jnp.asarray(codes)
+        scale_arr = jnp.asarray(lane_scales)
+    else:
+        slab_arr = jnp.asarray(slabs)
+        scale_arr = None
     index = IVFIndex(
         centroids=jnp.asarray(cents_np),
-        slabs=jnp.asarray(slabs),
+        slabs=slab_arr,
         slab_ids=jnp.asarray(slab_ids),
         num_items=num_items,
         nlist=nlist,
         slab_width=slab_width,
+        slab_scales=scale_arr,
     )
     info = {
         "nlist": nlist,
@@ -326,12 +349,24 @@ def build_ivf(
         "balance": float(balance),
         "kmeansIters": int(iters),
         "seed": int(seed),
-        "bytesIndex": int(
-            index.centroids.size * 4 + index.slabs.size * 4 + index.slab_ids.size * 4
-        ),
+        "quantized": bool(quantize),
+        "bytesIndex": _index_bytes(index),
         "buildSeconds": round(time.perf_counter() - t0, 3),
     }
     return index, info
+
+
+def _index_bytes(index: IVFIndex) -> int:
+    """Real served bytes of the index arrays (dtype-honest: int8 slabs
+    count 1 byte/element, their scales 4)."""
+    total = (
+        index.centroids.size * index.centroids.dtype.itemsize
+        + index.slabs.size * index.slabs.dtype.itemsize
+        + index.slab_ids.size * index.slab_ids.dtype.itemsize
+    )
+    if index.slab_scales is not None:
+        total += index.slab_scales.size * index.slab_scales.dtype.itemsize
+    return int(total)
 
 
 # ---------------------------------------------------------------------------
@@ -342,8 +377,11 @@ def build_ivf(
 def _host_mirror(index: IVFIndex) -> dict:
     """Mutable host-side view of an index for incremental maintenance:
     numpy slab copies, per-cluster fill counts, and an item -> slab-slot
-    map. Built once per index generation, reused across folds."""
-    slabs = np.array(index.slabs, dtype=np.float32)
+    map. Built once per index generation, reused across folds. For a
+    quantized index the mirror keeps the int8 codes AND the per-lane
+    scales — fold-ins then re-quantize only the touched lanes (delta
+    cost, never a full-catalog requantization)."""
+    slabs = np.array(index.slabs)  # f32 rows, or int8 codes (quantized)
     slab_ids = np.array(index.slab_ids, dtype=np.int32)
     cents = np.asarray(index.centroids, dtype=np.float32)
     sentinel = index.num_items
@@ -353,6 +391,11 @@ def _host_mirror(index: IVFIndex) -> dict:
     return {
         "slabs": slabs,
         "slab_ids": slab_ids,
+        "scales": (
+            np.array(index.slab_scales, dtype=np.float32)
+            if index.slab_scales is not None
+            else None
+        ),
         "centroids": cents,
         "c2": (cents * cents).sum(axis=1),
         "fill": (slab_ids != sentinel).sum(axis=1).astype(np.int64),
@@ -364,10 +407,14 @@ def _host_mirror(index: IVFIndex) -> dict:
 def _grow_width(state: dict, extra: int) -> None:
     nlist, width, dim = state["slabs"].shape
     pad = max(1, extra, width // 4)
-    slabs = np.zeros((nlist, width + pad, dim), np.float32)
+    slabs = np.zeros((nlist, width + pad, dim), state["slabs"].dtype)
     slabs[:, :width] = state["slabs"]
     ids = np.full((nlist, width + pad), state["capacity"], np.int32)
     ids[:, :width] = state["slab_ids"]
+    if state.get("scales") is not None:
+        scales = np.zeros((nlist, width + pad), np.float32)
+        scales[:, :width] = state["scales"]
+        state["scales"] = scales
     # re-derive positions: lane arithmetic changed with the width
     pos = np.full(state["capacity"], -1, np.int64)
     cl, lane = np.nonzero(ids != state["capacity"])
@@ -423,24 +470,42 @@ def update_ivf(
     ids = state["slab_ids"]
     fill = state["fill"]
     pos = state["pos"]
+    scales = state.get("scales")
     width = slabs.shape[1]
+    if scales is not None:
+        # quantized slabs: the mirror stores int8 codes + per-lane
+        # scales, so only the TOUCHED lanes re-quantize on scatter —
+        # the same delta-cost rule as the factor-table fold-in
+        from predictionio_tpu.ops import quant
+
+        lane_vals, lane_scales = quant.quantize_table_host(vectors)
+    else:
+        lane_vals, lane_scales = vectors, None
+
+    def write_lane(cl, lane, j):
+        slabs[cl, lane] = lane_vals[j]
+        if scales is not None:
+            scales[cl, lane] = lane_scales[j]
+
     # nearest-centroid preference order per changed item, via the GEMM
     # identity (||x||^2 is row-constant); the delta is small, so the
     # [M, nlist] block is cheap
     keys = state["c2"][None, :] - 2.0 * (vectors @ state["centroids"].T)
     prefs = np.argsort(keys, axis=1, kind="stable")
     moved = inserted = in_place = spilled = 0
-    for iid, vec, pref in zip(item_ids.tolist(), vectors, prefs):
+    for j, (iid, pref) in enumerate(zip(item_ids.tolist(), prefs)):
         cur = pos[iid]
         target = int(pref[0])
         if cur >= 0:
             cl, lane = divmod(int(cur), width)
             if cl == target:
-                slabs[cl, lane] = vec
+                write_lane(cl, lane, j)
                 in_place += 1
                 continue
             ids[cl, lane] = capacity  # tombstone out of the old slab
-            slabs[cl, lane] = 0.0
+            slabs[cl, lane] = 0
+            if scales is not None:
+                scales[cl, lane] = 0.0
             fill[cl] -= 1
             pos[iid] = -1
             moved += 1
@@ -452,7 +517,7 @@ def update_ivf(
                 continue
             lane = int(np.argmax(ids[c] == capacity))
             ids[c, lane] = iid
-            slabs[c, lane] = vec
+            write_lane(c, lane, j)
             fill[c] += 1
             pos[iid] = c * width + lane
             spilled += int(rank_i > 0)
@@ -463,10 +528,11 @@ def update_ivf(
             slabs = state["slabs"]
             ids = state["slab_ids"]
             pos = state["pos"]
+            scales = state.get("scales")
             width = slabs.shape[1]
             lane = int(np.argmax(ids[target] == capacity))
             ids[target, lane] = iid
-            slabs[target, lane] = vec
+            write_lane(target, lane, j)
             fill[target] += 1
             pos[iid] = target * width + lane
     new_index = IVFIndex(
@@ -480,6 +546,9 @@ def update_ivf(
         num_items=capacity,
         nlist=index.nlist,
         slab_width=width,
+        slab_scales=(
+            jnp.asarray(scales.copy()) if scales is not None else None
+        ),
     )
     info = {
         "inPlace": in_place,
@@ -512,18 +581,28 @@ def _shard_index(index: IVFIndex, mesh) -> IVFIndex:
     nlist_pad = -(-index.nlist // S) * S
     pad = nlist_pad - index.nlist
     cents = np.asarray(index.centroids, np.float32)
-    slabs = np.asarray(index.slabs, np.float32)
+    # dtype preserved: int8 codes shard as int8 (the whole point)
+    slabs = np.asarray(index.slabs)
     ids = np.asarray(index.slab_ids, np.int32)
+    scales = (
+        np.asarray(index.slab_scales, np.float32)
+        if index.slab_scales is not None
+        else None
+    )
     if pad:
         cents = np.concatenate(
             [cents, np.zeros((pad, cents.shape[1]), np.float32)]
         )
         slabs = np.concatenate(
-            [slabs, np.zeros((pad,) + slabs.shape[1:], np.float32)]
+            [slabs, np.zeros((pad,) + slabs.shape[1:], slabs.dtype)]
         )
         ids = np.concatenate(
             [ids, np.full((pad, ids.shape[1]), index.num_items, np.int32)]
         )
+        if scales is not None:
+            scales = np.concatenate(
+                [scales, np.zeros((pad, scales.shape[1]), np.float32)]
+            )
     ax = sharding.MODEL_AXIS
     return IVFIndex(
         centroids=jnp.asarray(cents),
@@ -536,6 +615,13 @@ def _shard_index(index: IVFIndex, mesh) -> IVFIndex:
         num_items=index.num_items,
         nlist=index.nlist,
         slab_width=index.slab_width,
+        slab_scales=(
+            jax.device_put(
+                scales, NamedSharding(mesh, PartitionSpec(ax, None))
+            )
+            if scales is not None
+            else None
+        ),
     )
 
 
@@ -552,11 +638,19 @@ def shard_runtime(runtime: "AnnRuntime", mesh) -> dict:
         index = runtime.index
     sharded = _shard_index(index, mesh)
     S = int(mesh.shape["model"])
+    sharded_bytes = (
+        sharded.slabs.size * sharded.slabs.dtype.itemsize
+        + sharded.slab_ids.size * sharded.slab_ids.dtype.itemsize
+    )
+    if sharded.slab_scales is not None:
+        sharded_bytes += (
+            sharded.slab_scales.size * sharded.slab_scales.dtype.itemsize
+        )
     delta = {
         "shards": S,
         "bytesIndexPerDevice": int(
-            sharded.centroids.size * 4
-            + (sharded.slabs.size * 4 + sharded.slab_ids.size * 4) // S
+            sharded.centroids.size * sharded.centroids.dtype.itemsize
+            + sharded_bytes // S
         ),
     }
     with runtime._lock:
@@ -578,13 +672,22 @@ def _ivf_topk(
     """Shared kernel body (trace-time ``k``/``nprobe``): score
     centroids, select clusters, score slabs, tie-stable global merge."""
     nlist, width = index.nlist, index.slab_width
+    lane_scales = index.slab_scales  # not None => int8 slab codes
     nprobe = max(1, min(int(nprobe), nlist))
     if nprobe >= nlist:
         # every cluster probed: skip stage 1 and the gather entirely and
         # score the whole cluster-major table with ONE [B,K]@[K,n*W]
         # GEMM — the same dot shape as the exact path, which is what
-        # makes this mode bit-identical to exact top-K (CI-asserted)
-        scores = qvecs @ index.slabs.reshape(nlist * width, -1).T
+        # makes this mode bit-identical to exact top-K (CI-asserted;
+        # in int8 mode the claim is determinism over the DEQUANTIZED
+        # table, the strongest statement a lossy layout admits)
+        flat = index.slabs.reshape(nlist * width, -1)
+        if lane_scales is not None:
+            scores = (qvecs @ flat.T.astype(jnp.float32)) * (
+                lane_scales.reshape(1, nlist * width)
+            )
+        else:
+            scores = qvecs @ flat.T
         ids = jnp.broadcast_to(
             index.slab_ids.reshape(1, nlist * width), scores.shape
         )
@@ -598,8 +701,17 @@ def _ivf_topk(
         id_l = []
         for j in range(nprobe):
             sel = probe[:, j]
-            cand = index.slabs[sel]  # [B, W, K]
-            score_l.append(jnp.einsum("bwk,bk->bw", cand, qvecs))
+            cand = index.slabs[sel]  # [B, W, K] — int8: 1/4 the gather bytes
+            if lane_scales is not None:
+                # dequantize AFTER the dot: one f32 multiply per lane
+                # instead of per element (measured faster on CPU, exact
+                # same value up to f32 rounding)
+                s_j = jnp.einsum(
+                    "bwk,bk->bw", cand.astype(jnp.float32), qvecs
+                ) * lane_scales[sel]
+            else:
+                s_j = jnp.einsum("bwk,bk->bw", cand, qvecs)
+            score_l.append(s_j)
             id_l.append(index.slab_ids[sel])
         scores = jnp.concatenate(score_l, axis=1)  # [B, nprobe*W]
         ids = jnp.concatenate(id_l, axis=1)

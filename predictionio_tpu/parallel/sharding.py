@@ -60,12 +60,15 @@ __all__ = [
     "ShardInfo",
     "serving_mesh",
     "shard_table",
+    "shard_quantized_table",
     "gather_rows",
     "sharded_topk_users",
+    "sharded_quantized_topk_users",
     "sharded_ivf_topk",
     "table_bytes",
     "sharded_table_bytes",
     "per_device_bytes",
+    "per_device_bytes_quantized",
 ]
 
 #: serving-side model axis name (matches the training mesh's axis so the
@@ -140,6 +143,35 @@ def shard_table(mat, mesh: Mesh, capacity: int = 0) -> jax.Array:
     return jax.device_put(mat, table_spec(mesh))
 
 
+def shard_quantized_table(mat, mesh: Mesh, capacity: int = 0):
+    """Quantize a host f32 table (``ops/quant``'s one rounding rule) and
+    place it int8-sharded over the mesh's model axis: codes
+    ``PartitionSpec('model', None)``, per-row scales
+    ``PartitionSpec('model')`` — per-device factor memory drops to
+    ``rows/S · (rank + 4)`` bytes, the multiplicative composition of the
+    sharding and quantization tiers (``pio deploy --shard-factors
+    --quantize int8``). Zero padding rows quantize to zero codes + zero
+    scale and stay masked by the logical row count like the f32 layout."""
+    from predictionio_tpu.ops import quant
+
+    mat = np.asarray(mat, dtype=np.float32)
+    if mat.ndim != 2:
+        raise ValueError(f"factor table must be 2-D, got {mat.shape}")
+    S = int(mesh.shape[MODEL_AXIS])
+    n_pad = padded_rows(max(mat.shape[0], capacity), S)
+    if n_pad != mat.shape[0]:
+        mat = np.concatenate(
+            [mat, np.zeros((n_pad - mat.shape[0], mat.shape[1]), mat.dtype)]
+        )
+    codes, scales = quant.quantize_table_host(mat)
+    return quant.QuantizedTable(
+        jax.device_put(codes, table_spec(mesh)),
+        jax.device_put(
+            scales, NamedSharding(mesh, PartitionSpec(MODEL_AXIS))
+        ),
+    )
+
+
 # ---------------------------------------------------------------------------
 # Byte accounting (the bench's memory model; pure shape math, CPU-safe)
 # ---------------------------------------------------------------------------
@@ -167,6 +199,13 @@ def per_device_bytes(arr) -> int:
     for s in arr.addressable_shards:
         per[s.device] = per.get(s.device, 0) + int(s.data.nbytes)
     return max(per.values()) if per else 0
+
+
+def per_device_bytes_quantized(qt) -> int:
+    """Measured per-device bytes of a sharded quantized table — codes
+    AND scales, read from the actual array shards so the scale bench
+    asserts served truth, not shape math."""
+    return per_device_bytes(qt.codes) + per_device_bytes(qt.scales)
 
 
 # ---------------------------------------------------------------------------
@@ -262,6 +301,92 @@ def sharded_topk_users(
     )(user_idx, user_tbl, item_tbl, jnp.asarray(num_items, jnp.int32))
 
 
+@functools.partial(jax.jit, static_argnames=("k", "kp", "mesh"))
+def sharded_quantized_topk_users(
+    user_idx: jax.Array,
+    u_codes: jax.Array,
+    u_scales: jax.Array,
+    i_codes: jax.Array,
+    i_scales: jax.Array,
+    k: int,
+    kp: int,
+    num_items: jax.Array,
+    mesh: Mesh,
+) -> tuple[jax.Array, jax.Array]:
+    """Two-stage quantized top-k over model-sharded int8 tables (``pio
+    deploy --shard-factors --quantize int8``), one dispatch per batch.
+
+    Per-device work: resolve + DEQUANTIZE the query rows from the local
+    user shard (masked gather + psum of ``[B, K]`` f32 rows — codes
+    never leave their shard), re-quantize the assembled queries
+    in-kernel, one int8×int8 ``[B,K]@[K,I/S]`` coarse GEMM over the
+    LOCAL item shard, a per-shard over-fetch of ``kp`` candidates, an
+    f32 rescore of ONLY those local candidates (each shard owns its
+    finalists, so the rescore gather never crosses the interconnect),
+    then the usual two-level tie-stable merge of ``S·k`` rescored
+    finalists. Every stage applies the shared
+    :func:`ops.topk.sort_merge_topk` rule on f32 rescored scores, so the
+    ordering is exact-f32-deterministic — and identical to the
+    replicated quantized kernel (and the f32 exact path's tie order)
+    whenever the over-fetch covers the true top-k, which is what the
+    bench's recall guard measures. (The per-shard over-fetch is a
+    SUPERSET of the replicated kernel's global one, so sharding can
+    only widen the rescored candidate pool, never narrow it.)"""
+    from predictionio_tpu.ops import quant
+
+    S = int(mesh.shape[MODEL_AXIS])
+    i_rps = i_codes.shape[0] // S
+    kk = min(int(k), i_rps)
+    kpp = max(kk, min(int(kp), i_rps))
+
+    def local(idx, uc, us, ic, isc, n_items):
+        rps = uc.shape[0]
+        me = jax.lax.axis_index(MODEL_AXIS)
+        lidx = idx - me * rps
+        inr = (lidx >= 0) & (lidx < rps)
+        sel = jnp.where(inr, lidx, 0)
+        rows = quant.dequantize(uc[sel], us[sel])
+        q = jax.lax.psum(jnp.where(inr[:, None], rows, 0.0), MODEL_AXIS)
+        q_codes, q_scales = quant.quantize_rows_traced(q)
+        acc = quant.int8_matmul(q_codes, ic)  # [B, I/S] int32
+        approx = acc.astype(jnp.float32) * q_scales[:, None] * isc[None, :]
+        base = (me * i_rps).astype(jnp.int32)
+        gid = base + jnp.arange(i_rps, dtype=jnp.int32)
+        approx = jnp.where(gid[None, :] < n_items, approx, -jnp.inf)
+        _, p = jax.lax.top_k(approx, kpp)  # local over-fetch
+        # rescore: gather + dequantize only the local finalists, score
+        # against the UNQUANTIZED f32 query
+        deq = quant.dequantize(ic[p], isc[p])  # [B, kpp, K]
+        exact = jnp.einsum("bpk,bk->bp", deq, q)
+        gi = base + p.astype(jnp.int32)
+        valid = gi < n_items
+        exact = jnp.where(valid, exact, -jnp.inf)
+        gi = jnp.where(valid, gi, n_items)
+        li, lv = sort_merge_topk(exact, gi, kk)
+        gv = jax.lax.all_gather(lv, MODEL_AXIS, axis=1, tiled=True)
+        gids = jax.lax.all_gather(li, MODEL_AXIS, axis=1, tiled=True)
+        return sort_merge_topk(gv, gids, min(int(k), S * kk))
+
+    P = PartitionSpec
+    return shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(
+            P(),
+            P(MODEL_AXIS, None),
+            P(MODEL_AXIS),
+            P(MODEL_AXIS, None),
+            P(MODEL_AXIS),
+            P(),
+        ),
+        out_specs=(P(), P()),
+        check_rep=False,
+    )(
+        user_idx, u_codes, u_scales, i_codes, i_scales,
+        jnp.asarray(num_items, jnp.int32),
+    )
+
+
 @functools.partial(jax.jit, static_argnames=("k", "nprobe", "mesh"))
 def sharded_ivf_topk(
     qvecs: jax.Array,
@@ -291,19 +416,26 @@ def sharded_ivf_topk(
     W = index.slab_width
     nlist_true = index.nlist
     num_items = index.num_items
+    quantized = index.slab_scales is not None  # int8 slab codes
     nprobe = max(1, min(int(nprobe), nlist_true))
     kk = max(1, min(int(k), nprobe * W))
 
-    def local(q, cent, slabs_l, ids_l):
+    def local(q, cent, slabs_l, ids_l, scales_l):
         me = jax.lax.axis_index(MODEL_AXIS)
         if nprobe >= nlist_true:
             # every cluster probed: skip stage 1 and score this shard's
             # whole cluster-major slab table with ONE GEMM — the same
             # per-item dot shape as the exact path and the unsharded
             # nprobe==nlist mode, which is what keeps this mode
-            # bit-identical to exact top-K (scores AND tie order)
+            # bit-identical to exact top-K (scores AND tie order; int8
+            # slabs keep determinism over the dequantized table)
             flat = slabs_l.reshape(-1, slabs_l.shape[-1])
-            scores = q @ flat.T  # [B, lists_per*W]
+            if quantized:
+                scores = (q @ flat.T.astype(jnp.float32)) * (
+                    scales_l.reshape(1, -1)
+                )
+            else:
+                scores = q @ flat.T  # [B, lists_per*W]
             ids = jnp.broadcast_to(
                 ids_l.reshape(1, -1), scores.shape
             )
@@ -323,9 +455,14 @@ def sharded_ivf_topk(
             # another shard read slab 0 but are fully masked out
             for j in range(nprobe):
                 sel = jnp.where(own[:, j], lp[:, j], 0)
-                cand = slabs_l[sel]  # [B, W, K]
+                cand = slabs_l[sel]  # [B, W, K] — int8: 1/4 gather bytes
                 ids_j = ids_l[sel]  # [B, W]
-                s_j = jnp.einsum("bwk,bk->bw", cand, q)
+                if quantized:
+                    s_j = jnp.einsum(
+                        "bwk,bk->bw", cand.astype(jnp.float32), q
+                    ) * scales_l[sel]
+                else:
+                    s_j = jnp.einsum("bwk,bk->bw", cand, q)
                 valid = own[:, j, None] & (ids_j < num_items)
                 sc_parts.append(jnp.where(valid, s_j, -jnp.inf))
                 id_parts.append(jnp.where(valid, ids_j, num_items))
@@ -339,6 +476,13 @@ def sharded_ivf_topk(
         return sort_merge_topk(gv, gi, min(int(k), S * kk))
 
     P = PartitionSpec
+    # zero-size scale placeholder when unquantized: shard_map wants a
+    # concrete operand per spec, and a dead [S, 0] input costs nothing
+    scales_arg = (
+        index.slab_scales
+        if quantized
+        else jnp.zeros((S, 0), jnp.float32)
+    )
     return shard_map(
         local,
         mesh=mesh,
@@ -347,10 +491,11 @@ def sharded_ivf_topk(
             P(),
             P(MODEL_AXIS, None, None),
             P(MODEL_AXIS, None),
+            P(MODEL_AXIS, None),
         ),
         out_specs=(P(), P()),
         check_rep=False,
-    )(qvecs, index.centroids, index.slabs, index.slab_ids)
+    )(qvecs, index.centroids, index.slabs, index.slab_ids, scales_arg)
 
 
 # ---------------------------------------------------------------------------

@@ -85,6 +85,14 @@ class CacheConfig:
     #: memory serve; top-K stays tie-stable-identical to the replicated
     #: exact path (parallel/sharding.py). Implies device residency.
     shard_factors: bool = False
+    #: serve factor tables (and IVF slabs under ``--ann``) as int8
+    #: codes + per-row f32 scales (``pio deploy --quantize int8``,
+    #: ops/quant.py): ~4x more catalog per device and ~4x less gather
+    #: traffic, recall-guarded by the two-stage int8-coarse/f32-rescore
+    #: kernels. None (default) serves f32 everywhere; composes
+    #: multiplicatively with ``shard_factors``. Implies device
+    #: residency.
+    quantize: str | None = None
     #: query field whose value names the per-entity invalidation scope
     #: (``"user"`` for the recommendation templates); None disables
     #: per-scope invalidation (only full flushes apply)
@@ -93,6 +101,10 @@ class CacheConfig:
     def __post_init__(self) -> None:
         if self.result_cache_entries < 1:
             raise ValueError("result_cache_entries must be >= 1")
+        if self.quantize not in (None, "int8"):
+            raise ValueError(
+                f"unsupported quantize mode {self.quantize!r} (int8)"
+            )
 
     @property
     def enabled(self) -> bool:
@@ -102,6 +114,7 @@ class CacheConfig:
             or self.coalesce
             or self.pin_model
             or self.shard_factors
+            or self.quantize is not None
         )
 
 
@@ -139,6 +152,10 @@ class CacheStats:
         self.entries = 0  # gauge
         self.bytes = 0  # gauge (approximate payload bytes)
         self.bytes_pinned = 0  # gauge: device-resident model state
+        #: gauge: per-dtype breakdown of bytes_pinned, read from the
+        #: ACTUAL pinned arrays (f32 vs int8 codes vs their scales) —
+        #: the bench asserts served truth here, not shape math
+        self.bytes_by_dtype: dict = {}
         self.factor_shards = 0  # gauge: --shard-factors model-axis size
         self.model_generation = 0  # gauge
 
@@ -172,6 +189,7 @@ class CacheStats:
                 "entries": self.entries,
                 "bytes": self.bytes,
                 "bytesPinned": self.bytes_pinned,
+                "bytesByDtype": dict(self.bytes_by_dtype),
                 "factorShards": self.factor_shards,
                 "modelGeneration": self.model_generation,
             }

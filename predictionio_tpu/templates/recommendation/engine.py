@@ -744,6 +744,7 @@ class ALSAlgorithm(JaxAlgorithm):
         model._pio_pinned = True
         nbytes = int(user.size) * user.dtype.itemsize
         nbytes += int(item.size) * item.dtype.itemsize
+        model._pio_bytes_by_dtype = {"float32": nbytes}
         return model, nbytes
 
     # ------------------------------------------------------ sharded serving
@@ -781,15 +782,85 @@ class ALSAlgorithm(JaxAlgorithm):
         model._pio_pinned = True
         nbytes = int(user.size) * user.dtype.itemsize
         nbytes += int(item.size) * item.dtype.itemsize
+        model._pio_bytes_by_dtype = {"float32": nbytes}
         return model, nbytes
+
+    # ---------------------------------------------------- quantized serving
+    def quantize_model_for_serving(
+        self, model: ALSModel, mode: str = "int8", shard: bool = False
+    ) -> tuple[ALSModel, int]:
+        """``--quantize int8`` tier (workflow/device_state.py): pin the
+        factor tables as int8 codes + per-row f32 scales (ops/quant.py's
+        one rounding rule) so the served catalog costs ``rank + 4``
+        bytes per row instead of ``4·rank``. Serving routes through the
+        recall-guarded two-stage kernel (int8 coarse scan over-fetching
+        ``max(4k, k+64)``, f32 rescore of only the gathered candidates,
+        shared tie rule). ``shard=True`` composes with
+        ``--shard-factors``: codes and scales shard over the model mesh,
+        so per-device bytes are ``catalog·(rank+4)/S`` — the tiers
+        multiply. Returns ``(model, real pinned bytes)``; the per-dtype
+        ledger lands on ``model._pio_bytes_by_dtype``."""
+        from predictionio_tpu.ops import quant
+
+        user_f = np.asarray(model.user_factors, np.float32)
+        item_f = np.asarray(model.item_factors, np.float32)
+        mesh = None
+        if shard:
+            from predictionio_tpu.parallel import sharding
+
+            mesh = sharding.serving_mesh()
+            if mesh is None:
+                logging.getLogger(__name__).warning(
+                    "--shard-factors requested but only one device is "
+                    "visible; quantized tables pin replicated"
+                )
+        if mesh is not None:
+            from predictionio_tpu.parallel import sharding
+
+            user = sharding.shard_quantized_table(user_f, mesh)
+            item = sharding.shard_quantized_table(item_f, mesh)
+            model._pio_shards = sharding.ShardInfo(
+                mesh=mesh,
+                rows={
+                    "user": int(user_f.shape[0]),
+                    "item": int(item_f.shape[0]),
+                },
+            )
+        else:
+            user = quant.quantize_table(user_f)
+            item = quant.quantize_table(item_f)
+        model.user_factors = user
+        model.item_factors = item
+        model._pio_pinned = True
+        breakdown = {
+            "int8": user.nbytes_codes + item.nbytes_codes,
+            "scalesFloat32": user.nbytes_scales + item.nbytes_scales,
+        }
+        model._pio_bytes_by_dtype = breakdown
+        model._pio_quant = quant.QuantRuntime(
+            mode=mode,
+            bytes_by_dtype=breakdown,
+            bytes_f32=user_f.nbytes + item_f.nbytes,
+            # item-side error is what reorders results; one pass at
+            # load time, reported on /stats.json quant
+            error=quant.quantization_error(
+                item_f,
+                np.asarray(item.codes)[: item_f.shape[0]],
+                np.asarray(item.scales)[: item_f.shape[0]],
+            ),
+        )
+        return model, sum(breakdown.values())
 
     def release_pinned_model(self, model: ALSModel) -> None:
         """Drop a superseded generation's pinned buffers (hot reload must
         not accumulate one catalog of device memory per swap). For a
         SHARDED generation this must drop every device's shard handles —
         not just device 0's — so the host-gather strips the even-shard
-        padding and the ShardInfo goes with the buffers."""
+        padding and the ShardInfo goes with the buffers. Quantized
+        tables dequantize back to host f32 (np.asarray reads through the
+        codes), and the QuantRuntime goes with them."""
         shards = getattr(model, "_pio_shards", None)
+        quantized = getattr(model, "_pio_quant", None) is not None
         if shards is not None:
             model.user_factors = np.asarray(model.user_factors)[
                 : shards.rows["user"]
@@ -799,11 +870,13 @@ class ALSAlgorithm(JaxAlgorithm):
             ]
             model._pio_shards = None
             model._pio_pinned = False
+            model._pio_quant = None
             return
-        if getattr(model, "_pio_pinned", False):
+        if getattr(model, "_pio_pinned", False) or quantized:
             model.user_factors = np.asarray(model.user_factors)
             model.item_factors = np.asarray(model.item_factors)
             model._pio_pinned = False
+            model._pio_quant = None
 
     # --------------------------------------------------- ANN retrieval
     def build_ann_for_serving(self, model: ALSModel, ann) -> tuple[ALSModel, dict]:
@@ -816,6 +889,8 @@ class ALSAlgorithm(JaxAlgorithm):
         from predictionio_tpu.ops import ivf
 
         shards = getattr(model, "_pio_shards", None)
+        # np.asarray dequantizes a --quantize table; k-means runs on the
+        # f32 values either way, and the SERVED slabs re-quantize below
         items = np.asarray(model.item_factors)
         if shards is not None:
             # sharded tables carry even-shard padding rows — the index
@@ -824,6 +899,10 @@ class ALSAlgorithm(JaxAlgorithm):
         index, info = ivf.build_ivf(
             items,
             nlist=ann.nlist, seed=ann.seed, iters=ann.kmeans_iters,
+            # --quantize composition: slabs stored int8 + per-lane
+            # scales, so per-probe gather bytes drop ~4x (the centroid
+            # stage stays f32)
+            quantize=getattr(model, "_pio_quant", None) is not None,
         )
         model._pio_ann = ivf.AnnRuntime(index, ann.nprobe, info)
         if shards is not None:
@@ -1040,10 +1119,17 @@ class ALSAlgorithm(JaxAlgorithm):
             return PredictedResult(())
         ann = getattr(model, "_pio_ann", None)
         shards = getattr(model, "_pio_shards", None)
+        quantrt = getattr(model, "_pio_quant", None)
         if ann is not None:
             from predictionio_tpu.ops import ivf
 
-            if shards is not None:
+            if quantrt is not None:
+                # quantized user table: __getitem__ dequantizes only the
+                # requested row (sharded or not)
+                qvec = np.asarray(
+                    model.user_factors[np.asarray([uidx], np.int64)]
+                )[0]
+            elif shards is not None:
                 from predictionio_tpu.parallel import sharding
 
                 qvec = np.asarray(
@@ -1056,6 +1142,19 @@ class ALSAlgorithm(JaxAlgorithm):
                 qvec = np.asarray(model.user_factors[uidx])
             ids, scores = ivf.query_topk(ann, qvec, k)
             pairs = list(zip(ids, scores))
+        elif quantrt is not None:
+            # quantized exact: int8 coarse scan with over-fetch, f32
+            # rescore of the gathered candidates (ops/quant.py); routes
+            # through the shard_map kernel under --shard-factors
+            from predictionio_tpu.ops import quant
+
+            ids_b, scores_b = quant.topk_users(
+                quantrt, model.user_factors, model.item_factors,
+                [uidx], k, shards=shards,
+            )
+            pairs = [
+                (int(i), float(s)) for i, s in zip(ids_b[0], scores_b[0])
+            ]
         elif shards is not None:
             # sharded exact: one dispatch, each device scores its item
             # shard, only the S*k finalists cross the interconnect
@@ -1134,6 +1233,7 @@ class ALSAlgorithm(JaxAlgorithm):
             chunk=self.BATCH_PREDICT_CHUNK,
             ann=getattr(model, "_pio_ann", None),
             shards=getattr(model, "_pio_shards", None),
+            quant=getattr(model, "_pio_quant", None),
         )
 
     def batch_predict_json(

@@ -18,9 +18,39 @@ logger = logging.getLogger(__name__)
 TOPK_CHUNK = 2048
 
 
+def _drain_staged(
+    staged: list, n_items: int, chunk: int
+) -> Iterator[tuple[list, list, list]]:
+    """Drain chunk-staged device results with ONE link crossing: concat
+    all chunks' ids/scores on device, transfer once, then trim each
+    row's sentinel padding (id >= n_items at -inf) before any consumer
+    sees it — shared by the ANN and quantized staging paths."""
+    import jax.numpy as jnp
+
+    if len(staged) > 1:
+        idx_all = np.asarray(
+            jnp.concatenate([i for _, i, _ in staged], axis=0)
+        )
+        score_all = np.asarray(
+            jnp.concatenate([s for _, _, s in staged], axis=0)
+        )
+    else:
+        idx_all = np.asarray(staged[0][1])
+        score_all = np.asarray(staged[0][2])
+    off = 0
+    for part, _, _ in staged:
+        ids_l, scores_l = [], []
+        for r in range(len(part)):
+            keep = idx_all[off + r] < n_items
+            ids_l.append(idx_all[off + r][keep].tolist())
+            scores_l.append(score_all[off + r][keep].tolist())
+        yield part, ids_l, scores_l
+        off += chunk
+
+
 def chunked_topk(
     user_mat, item_mat, valid: Sequence[tuple], chunk: int = TOPK_CHUNK,
-    ann=None, shards=None,
+    ann=None, shards=None, quant=None,
 ) -> Iterator[tuple[list, list, list]]:
     """Chunked batch top-k over ``valid = [(slot, uidx, k), ...]``;
     yields ``(part, ids, scores)`` with ids/scores as Python lists — the
@@ -50,7 +80,15 @@ def chunked_topk(
     the exact path routes through the shard_map kernel (each device
     scores only its ``[B,K]@[K,I/S]`` slice; tie-stable-identical
     results), and the ANN path resolves query rows through the sharded
-    gather before the cluster-sharded probe kernel."""
+    gather before the cluster-sharded probe kernel.
+
+    ``quant`` (a :class:`predictionio_tpu.ops.quant.QuantRuntime`, the
+    ``--quantize int8`` tier) means both tables are int8 codes + per-row
+    scales: the exact path runs the two-stage kernel (int8 coarse scan
+    over-fetching ``max(4k, k+64)``, f32 rescore of only the gathered
+    candidates), composing with ``shards`` through the shard_map
+    variant; the ANN path dequantizes only the chunk's query rows and
+    probes the (int8-slabbed) index as usual."""
     if not valid:
         return
     # under --shard-factors the physical table is padded to a multiple
@@ -67,11 +105,31 @@ def chunked_topk(
         from predictionio_tpu.ops import ivf
 
         user_on_device = not isinstance(user_mat, np.ndarray)
+        user_quantized = getattr(user_mat, "is_quantized", False)
         ann_staged: list = []
         for lo in range(0, len(valid), chunk):
             part = list(valid[lo : lo + chunk])
             uidx_arr = np.fromiter((u for _, u, _ in part), np.int32, len(part))
-            if shards is not None:
+            if user_quantized:
+                # --quantize: dequantize ONLY the chunk's user rows (the
+                # f32 queries the probe stage scores with); the probed
+                # slabs themselves stay int8 inside the index. The rows
+                # stay ON DEVICE — a host round trip here would
+                # serialize the chunk dispatches
+                padded = np.zeros(chunk, np.int32)
+                padded[: len(part)] = uidx_arr
+                qv = user_mat[jnp.asarray(padded)]
+                if shards is not None:
+                    from predictionio_tpu.parallel import sharding
+
+                    idx_b, score_b = sharding.sharded_ivf_topk(
+                        qv, ann.index, k_max, ann.nprobe, shards.mesh
+                    )
+                else:
+                    idx_b, score_b = ivf.ivf_topk_batch(
+                        qv, ann.index, k_max, ann.nprobe
+                    )
+            elif shards is not None:
                 from predictionio_tpu.parallel import sharding
 
                 padded = np.zeros(chunk, np.int32)
@@ -99,27 +157,23 @@ def chunked_topk(
             ann_staged.append((part, idx_b, score_b))
         # same staging discipline as the exact device path below: keep
         # dispatches async across chunks, cross the link ONCE
-        if len(ann_staged) > 1:
-            idx_all = np.asarray(
-                jnp.concatenate([i for _, i, _ in ann_staged], axis=0)
+        yield from _drain_staged(ann_staged, n_items, chunk)
+        return
+    if quant is not None:
+        from predictionio_tpu.ops import quant as quant_ops
+
+        q_staged: list = []
+        for lo in range(0, len(valid), chunk):
+            part = list(valid[lo : lo + chunk])
+            padded = np.zeros(chunk, np.int32)
+            padded[: len(part)] = np.fromiter(
+                (u for _, u, _ in part), np.int32, len(part)
             )
-            score_all = np.asarray(
-                jnp.concatenate([s for _, _, s in ann_staged], axis=0)
+            idx_b, score_b = quant_ops.run_topk(
+                quant, user_mat, item_mat, padded, k_max, shards=shards
             )
-        else:
-            idx_all = np.asarray(ann_staged[0][1])
-            score_all = np.asarray(ann_staged[0][2])
-        off = 0
-        for part, _, _ in ann_staged:
-            ids_l, scores_l = [], []
-            for r in range(len(part)):
-                i_r, s_r = ivf.trim_row(
-                    idx_all[off + r], score_all[off + r], n_items
-                )
-                ids_l.append(i_r)
-                scores_l.append(s_r)
-            yield part, ids_l, scores_l
-            off += chunk
+            q_staged.append((part, idx_b, score_b))
+        yield from _drain_staged(q_staged, n_items, chunk)
         return
     on_device = not isinstance(item_mat, np.ndarray)
     staged: list[tuple[list, object, object]] = []

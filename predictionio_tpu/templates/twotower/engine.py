@@ -386,6 +386,7 @@ class TwoTowerAlgorithm(JaxAlgorithm):
         model._pio_pinned = True
         nbytes = int(user.size) * user.dtype.itemsize
         nbytes += int(item.size) * item.dtype.itemsize
+        model._pio_bytes_by_dtype = {"float32": nbytes}
         return model, nbytes
 
     # ------------------------------------------------------ sharded serving
@@ -421,13 +422,75 @@ class TwoTowerAlgorithm(JaxAlgorithm):
         model._pio_pinned = True
         nbytes = int(user.size) * user.dtype.itemsize
         nbytes += int(item.size) * item.dtype.itemsize
+        model._pio_bytes_by_dtype = {"float32": nbytes}
         return model, nbytes
+
+    # ---------------------------------------------------- quantized serving
+    def quantize_model_for_serving(
+        self, model: TwoTowerServingModel, mode: str = "int8",
+        shard: bool = False,
+    ) -> tuple[TwoTowerServingModel, int]:
+        """``--quantize int8`` tier: same contract as the recommendation
+        template — tower matrices pin as int8 codes + per-row scales,
+        retrieval runs the recall-guarded two-stage kernel, and
+        ``shard=True`` shards codes and scales over the model mesh so
+        the memory tiers compose multiplicatively."""
+        from predictionio_tpu.ops import quant
+
+        user_f = np.asarray(model.user_vecs, np.float32)
+        item_f = np.asarray(model.item_vecs, np.float32)
+        mesh = None
+        if shard:
+            from predictionio_tpu.parallel import sharding
+
+            mesh = sharding.serving_mesh()
+            if mesh is None:
+                logging.getLogger(__name__).warning(
+                    "--shard-factors requested but only one device is "
+                    "visible; quantized tables pin replicated"
+                )
+        if mesh is not None:
+            from predictionio_tpu.parallel import sharding
+
+            user = sharding.shard_quantized_table(user_f, mesh)
+            item = sharding.shard_quantized_table(item_f, mesh)
+            model._pio_shards = sharding.ShardInfo(
+                mesh=mesh,
+                rows={
+                    "user": int(user_f.shape[0]),
+                    "item": int(item_f.shape[0]),
+                },
+            )
+        else:
+            user = quant.quantize_table(user_f)
+            item = quant.quantize_table(item_f)
+        model.user_vecs = user
+        model.item_vecs = item
+        model._pio_pinned = True
+        breakdown = {
+            "int8": user.nbytes_codes + item.nbytes_codes,
+            "scalesFloat32": user.nbytes_scales + item.nbytes_scales,
+        }
+        model._pio_bytes_by_dtype = breakdown
+        model._pio_quant = quant.QuantRuntime(
+            mode=mode,
+            bytes_by_dtype=breakdown,
+            bytes_f32=user_f.nbytes + item_f.nbytes,
+            error=quant.quantization_error(
+                item_f,
+                np.asarray(item.codes)[: item_f.shape[0]],
+                np.asarray(item.scales)[: item_f.shape[0]],
+            ),
+        )
+        return model, sum(breakdown.values())
 
     def release_pinned_model(self, model: TwoTowerServingModel) -> None:
         shards = getattr(model, "_pio_shards", None)
+        quantized = getattr(model, "_pio_quant", None) is not None
         if shards is not None:
             # every device's shard handles die here, and the host copy
-            # strips the even-shard padding rows
+            # strips the even-shard padding rows (np.asarray dequantizes
+            # a --quantize table back to f32)
             model.user_vecs = np.asarray(model.user_vecs)[
                 : shards.rows["user"]
             ]
@@ -436,11 +499,13 @@ class TwoTowerAlgorithm(JaxAlgorithm):
             ]
             model._pio_shards = None
             model._pio_pinned = False
+            model._pio_quant = None
             return
-        if getattr(model, "_pio_pinned", False):
+        if getattr(model, "_pio_pinned", False) or quantized:
             model.user_vecs = np.asarray(model.user_vecs)
             model.item_vecs = np.asarray(model.item_vecs)
             model._pio_pinned = False
+            model._pio_quant = None
 
     # --------------------------------------------------- ANN retrieval
     def build_ann_for_serving(
@@ -455,12 +520,13 @@ class TwoTowerAlgorithm(JaxAlgorithm):
         from predictionio_tpu.ops import ivf
 
         shards = getattr(model, "_pio_shards", None)
-        items = np.asarray(model.item_vecs)
+        items = np.asarray(model.item_vecs)  # dequantizes under --quantize
         if shards is not None:
             items = items[: shards.rows["item"]]
         index, info = ivf.build_ivf(
             items,
             nlist=ann.nlist, seed=ann.seed, iters=ann.kmeans_iters,
+            quantize=getattr(model, "_pio_quant", None) is not None,
         )
         model._pio_ann = ivf.AnnRuntime(index, ann.nprobe, info)
         if shards is not None:
@@ -555,6 +621,7 @@ class TwoTowerAlgorithm(JaxAlgorithm):
             model.user_vecs, model.item_vecs, valid,
             ann=getattr(model, "_pio_ann", None),
             shards=getattr(model, "_pio_shards", None),
+            quant=getattr(model, "_pio_quant", None),
         ):
             for (oi, _, k), ids, scs in zip(part, idx_l, score_l):
                 seen = seen_by_slot[oi]
@@ -583,10 +650,15 @@ class TwoTowerAlgorithm(JaxAlgorithm):
             return PredictedResult(())
         ann = getattr(model, "_pio_ann", None)
         shards = getattr(model, "_pio_shards", None)
+        quantrt = getattr(model, "_pio_quant", None)
         if ann is not None:
             from predictionio_tpu.ops import ivf
 
-            if shards is not None:
+            if quantrt is not None:
+                qvec = np.asarray(
+                    model.user_vecs[np.asarray([uidx], np.int64)]
+                )[0]
+            elif shards is not None:
                 from predictionio_tpu.parallel import sharding
 
                 qvec = np.asarray(
@@ -599,6 +671,14 @@ class TwoTowerAlgorithm(JaxAlgorithm):
                 qvec = np.asarray(model.user_vecs[uidx])
             ids, sc = ivf.query_topk(ann, qvec, k)
             pairs = list(zip(ids, sc))
+        elif quantrt is not None:
+            from predictionio_tpu.ops import quant
+
+            ids_b, sc_b = quant.topk_users(
+                quantrt, model.user_vecs, model.item_vecs, [uidx], k,
+                shards=shards,
+            )
+            pairs = [(int(i), float(s)) for i, s in zip(ids_b[0], sc_b[0])]
         elif shards is not None:
             from predictionio_tpu.parallel import sharding
 

@@ -244,6 +244,20 @@ def build_parser() -> argparse.ArgumentParser:
         "stays tie-stable-identical to the replicated path, and --ann "
         "slabs shard over the same axis (docs/serving.md)",
     )
+    # ---- quantized serving (predictionio_tpu.ops.quant; docs/serving.md).
+    # Strictly opt-in: without --quantize every table serves f32 and the
+    # module is never imported.
+    deploy.add_argument(
+        "--quantize", choices=("int8",), default=None, metavar="DTYPE",
+        help="serve factor tables (and --ann IVF slabs) as int8 codes + "
+        "per-row f32 scales: ~4x more catalog per device and ~4x less "
+        "gather traffic, recall-guarded by a two-stage kernel (int8 "
+        "coarse scan over-fetching max(4k, k+64), f32 rescore of only "
+        "the gathered candidates). Composes with --shard-factors "
+        "(catalog/S/4 bytes per device), --pin-model, --ann and "
+        "--online (touched rows re-quantize on fold-in); /stats.json "
+        "grows a 'quant' section (docs/serving.md)",
+    )
     # ---- approximate retrieval (predictionio_tpu.ops.ivf; docs/serving.md).
     # Strictly opt-in: without --ann every query scores the exact path.
     deploy.add_argument(
@@ -812,7 +826,7 @@ def main(argv: list[str] | None = None) -> int:
             cache = None
             if (
                 args.result_cache or args.coalesce or args.pin_model
-                or args.shard_factors
+                or args.shard_factors or args.quantize
             ):
                 from predictionio_tpu.serving import CacheConfig
 
@@ -826,6 +840,7 @@ def main(argv: list[str] | None = None) -> int:
                     coalesce=args.coalesce,
                     pin_model=args.pin_model,
                     shard_factors=args.shard_factors,
+                    quantize=args.quantize,
                     scope_field=(
                         None
                         if args.cache_scope_field.lower() in ("none", "")

@@ -43,6 +43,7 @@ __all__ = [
     "pin_pairs",
     "release_pairs",
     "build_ann_pairs",
+    "bytes_by_dtype",
     "set_rows",
     "append_rows",
     "swap_side_rows",
@@ -53,7 +54,9 @@ __all__ = [
 logger = logging.getLogger(__name__)
 
 
-def pin_pairs(pairs: Sequence, shard: bool = False) -> tuple[list, int]:
+def pin_pairs(
+    pairs: Sequence, shard: bool = False, quantize: str | None = None
+) -> tuple[list, int]:
     """Pin every (algorithm, model) pair that supports it.
 
     Returns ``(pairs, bytes_pinned)`` — the possibly-replaced pair list
@@ -66,7 +69,15 @@ def pin_pairs(pairs: Sequence, shard: bool = False) -> tuple[list, int]:
     per device over a one-axis model mesh instead of a full replica, so
     per-device factor memory is ``O(table / num_devices)`` — falling
     back to plain pinning when the hook is absent (or the host has one
-    device, where sharding IS replication)."""
+    device, where sharding IS replication).
+
+    ``quantize`` (``pio deploy --quantize int8``) prefers the
+    ``quantize_model_for_serving(model, mode, shard)`` hook above both:
+    factor tables pin as int8 codes + per-row f32 scales (``ops/quant``)
+    so per-device factor bytes drop another ~4x ON TOP of the ``/S``
+    from sharding — the two tiers compose multiplicatively. Hooks set
+    ``model._pio_bytes_by_dtype`` so :func:`bytes_by_dtype` can report
+    the served per-dtype ledger, not recomputed shape math."""
     try:
         import jax  # noqa: F401  (availability probe only)
     except Exception:  # pragma: no cover - jax is a hard dep in practice
@@ -77,7 +88,19 @@ def pin_pairs(pairs: Sequence, shard: bool = False) -> tuple[list, int]:
     total = 0
     for algo, model in pairs:
         pin = None
-        if shard:
+        if quantize is not None:
+            qhook = getattr(algo, "quantize_model_for_serving", None)
+            if qhook is not None:
+                def pin(m, _q=qhook):
+                    return _q(m, mode=quantize, shard=shard)
+                pin.__name__ = "quantize_model_for_serving"
+            else:
+                logger.warning(
+                    "--quantize requested but %s has no "
+                    "quantize_model_for_serving hook; serving f32",
+                    type(algo).__name__,
+                )
+        if pin is None and shard:
             pin = getattr(algo, "shard_model_for_serving", None)
         if pin is None:
             pin = getattr(algo, "pin_model_for_serving", None)
@@ -95,6 +118,22 @@ def pin_pairs(pairs: Sequence, shard: bool = False) -> tuple[list, int]:
             )
         out.append((algo, model))
     return out, total
+
+
+def bytes_by_dtype(pairs: Sequence) -> dict:
+    """Aggregate per-dtype pinned-byte ledger across the served models —
+    the ``cache.bytesByDtype`` block of ``/stats.json``. Each pin hook
+    records its own breakdown on ``model._pio_bytes_by_dtype`` from the
+    ACTUAL arrays it placed (``{"float32": ...}`` for the classic tiers,
+    ``{"int8": ..., "scalesFloat32": ...}`` quantized), so the stats
+    report served truth instead of recomputed shape math."""
+    agg: dict = {}
+    for _, model in pairs:
+        for dtype, nbytes in (
+            getattr(model, "_pio_bytes_by_dtype", None) or {}
+        ).items():
+            agg[dtype] = agg.get(dtype, 0) + int(nbytes)
+    return agg
 
 
 def shard_count(pairs: Sequence) -> int:
@@ -158,9 +197,25 @@ def set_rows(mat, idx, rows):
     copy-on-write and swap whole (an in-place row write could hand a
     concurrent reader a torn vector — attribute assignment of the new
     array is atomic, the old array stays internally consistent for any
-    in-flight query that already grabbed it)."""
+    in-flight query that already grabbed it).
+
+    A quantized table (``--quantize int8``) re-quantizes ONLY the
+    touched rows on scatter — codes and per-row scales each route back
+    through this same function, so the sharded/pinned/host scatter
+    machinery is shared and freshness survives quantization at delta
+    cost."""
     import numpy as np
 
+    if getattr(mat, "is_quantized", False):
+        from predictionio_tpu.ops import quant
+
+        codes, scales = quant.quantize_table_host(
+            np.asarray(rows, np.float32)
+        )
+        return type(mat)(
+            set_rows(mat.codes, idx, codes),
+            set_rows(mat.scales, idx, scales),
+        )
     if isinstance(mat, np.ndarray):
         out = mat.copy()
         out[np.asarray(idx, np.int64)] = np.asarray(rows, mat.dtype)
@@ -223,9 +278,21 @@ def _sharded_set_rows(sharding):
 
 def append_rows(mat, rows):
     """Grow a factor table by cold-start rows (fold-in injection for
-    never-seen entities); stays on device when the table is pinned."""
+    never-seen entities); stays on device when the table is pinned.
+    Quantized tables quantize only the NEW rows and grow codes + scales
+    in step."""
     import numpy as np
 
+    if getattr(mat, "is_quantized", False):
+        from predictionio_tpu.ops import quant
+
+        codes, scales = quant.quantize_table_host(
+            np.asarray(rows, np.float32)
+        )
+        return type(mat)(
+            append_rows(mat.codes, codes),
+            append_rows(mat.scales, scales),
+        )
     if isinstance(mat, np.ndarray):
         return np.concatenate([mat, np.asarray(rows, mat.dtype)], axis=0)
     import jax.numpy as jnp
@@ -303,8 +370,17 @@ def swap_side_rows(
             else:
                 from predictionio_tpu.parallel import sharding
 
+                # np.asarray dequantizes a quantized table — the
+                # re-layout round-trips through f32 and re-quantizes,
+                # which is value-stable (quantize∘dequantize is the
+                # identity on already-quantized rows)
                 host = np.asarray(mat)[:logical]
-                out = sharding.shard_table(
+                relayout = (
+                    sharding.shard_quantized_table
+                    if getattr(mat, "is_quantized", False)
+                    else sharding.shard_table
+                )
+                out = relayout(
                     np.concatenate([host, rows[new]]),
                     shards.mesh,
                     capacity=logical + len(new) + sharding.GROW_STEP,

@@ -144,11 +144,18 @@ class QueryService:
         # Set BEFORE reload() so the index builds with the first load.
         self.ann_config = ann if ann is not None and ann.enabled else None
         #: retrieval-mode tag mixed into cache/singleflight keys so
-        #: exact and ANN results can never serve each other
+        #: exact and ANN results can never serve each other — and, with
+        #: --quantize, so a quantized deployment's (rescored) results
+        #: never serve an f32 deployment's entries or vice versa
         self._cache_mode = (
             self.ann_config.cache_mode if self.ann_config is not None
             else "exact"
         )
+        quantize_mode = (
+            cache.quantize if cache is not None and cache.enabled else None
+        )
+        if quantize_mode:
+            self._cache_mode = f"{self._cache_mode}+q{quantize_mode}"
         #: AnnRuntime per ANN-built model of the LIVE generation
         #: (swapped with the pairs under the lock on every reload)
         self._ann_runtimes: list = []
@@ -340,19 +347,27 @@ class QueryService:
                 self.ctx, engine_params, instance.id, model.models
             )
             if self.cache_config is not None and (
-                self.cache_config.pin_model or self.cache_config.shard_factors
+                self.cache_config.pin_model
+                or self.cache_config.shard_factors
+                or self.cache_config.quantize is not None
             ):
                 # device-resident tier: factor state pinned once per model
                 # generation (lazy boundary — serving/ stays jax-free;
                 # docs/performance.md). --shard-factors pins SHARDS per
                 # device instead of replicas so per-device memory scales
-                # as catalog / num_devices (docs/serving.md).
+                # as catalog / num_devices; --quantize pins int8 codes +
+                # per-row scales for another ~4x on top (docs/serving.md).
                 from predictionio_tpu.workflow import device_state
 
                 pairs, bytes_pinned = device_state.pin_pairs(
-                    pairs, shard=self.cache_config.shard_factors
+                    pairs,
+                    shard=self.cache_config.shard_factors,
+                    quantize=self.cache_config.quantize,
                 )
                 self._cache_stats.set_gauge("bytes_pinned", bytes_pinned)
+                self._cache_stats.set_gauge(
+                    "bytes_by_dtype", device_state.bytes_by_dtype(pairs)
+                )
                 if self.cache_config.shard_factors:
                     self._cache_stats.set_gauge(
                         "factor_shards", device_state.shard_count(pairs)
@@ -423,6 +438,7 @@ class QueryService:
                     and (
                         self.cache_config.pin_model
                         or self.cache_config.shard_factors
+                        or self.cache_config.quantize is not None
                     )
                 )
                 or self.ann_config is not None
@@ -820,6 +836,19 @@ class QueryService:
                 self.cache_config is not None
                 and self.cache_config.shard_factors
             ),
+            "quantize": (
+                self.cache_config.quantize
+                if self.cache_config is not None
+                else None
+            ),
+            # per-dtype ledger of the pinned device state (f32 vs int8
+            # codes vs their scales) — same served-truth numbers as
+            # /stats.json cache.bytesByDtype
+            "bytesPinnedByDtype": (
+                self._cache_stats.to_json()["bytesByDtype"]
+                if self._cache_stats is not None
+                else {}
+            ),
             "ann": self.ann_config is not None,
             "online": self.online is not None,
             # degraded-mode semantics (docs/operations.md): serving the
@@ -874,6 +903,24 @@ class QueryService:
             out["online"] = dict(
                 self.online.stats_json(), updatesApplied=applied
             )
+        if (
+            self.cache_config is not None
+            and self.cache_config.quantize is not None
+        ):
+            # quantized-serving decomposition (docs/serving.md): dtype,
+            # the real byte ledger (codes/scales vs the f32 the same
+            # catalog would cost), measured quantization error, and the
+            # MEASURED rescore depth the over-fetch actually paid
+            with self._lock:
+                q_pairs = list(self._algo_model_pairs)
+            out["quant"] = {
+                "dtype": self.cache_config.quantize,
+                "models": [
+                    rt.stats_json()
+                    for _, model in q_pairs
+                    if (rt := getattr(model, "_pio_quant", None)) is not None
+                ],
+            }
         if self.ann_config is not None:
             # approximate-retrieval decomposition (docs/serving.md):
             # effective nlist/nprobe plus, per built index, clusters

@@ -1117,6 +1117,52 @@ def test_pio304_raw_shard_map():
     assert _codes("predictionio_tpu/ops/x.py", suppressed) == []
 
 
+def test_pio305_raw_int8_quantization():
+    astype_jnp = """\
+    import jax.numpy as jnp
+
+    def f(x):
+        return x.astype(jnp.int8)
+    """
+    # one quantization rule, one module: every scoped package fires
+    assert _codes("predictionio_tpu/ops/x.py", astype_jnp) == ["PIO305"]
+    assert _codes("predictionio_tpu/parallel/x.py", astype_jnp) == ["PIO305"]
+    assert _codes("predictionio_tpu/workflow/x.py", astype_jnp) == ["PIO305"]
+    # string-dtype and keyword spellings are the same finding
+    astype_str = """\
+    def f(x):
+        return x.astype("int8")
+    """
+    assert _codes("predictionio_tpu/ops/x.py", astype_str) == ["PIO305"]
+    dtype_kw = """\
+    import numpy as np
+
+    def f(n):
+        return np.zeros(n, dtype=np.int8)
+    """
+    found = _find("predictionio_tpu/workflow/x.py", dtype_kw)
+    assert [f.code for f in found] == ["PIO305"]
+    assert "ops.quant" in found[0].message
+    # the quant module itself is the one legal home
+    assert _codes("predictionio_tpu/ops/quant.py", astype_jnp) == []
+    # host-side packages (templates, serving, ...) are out of scope
+    assert _codes("predictionio_tpu/templates/x.py", astype_jnp) == []
+    # reading int8 ARRAYS is fine — only constructing the dtype is the
+    # contained act (gathers/astype-to-f32 appear all over the kernels)
+    reads = """\
+    import jax.numpy as jnp
+
+    def f(codes, scales):
+        return codes.astype(jnp.float32) * scales[..., None]
+    """
+    assert _codes("predictionio_tpu/ops/x.py", reads) == []
+    suppressed = (
+        "import numpy as np\n"
+        "x = np.zeros(4, dtype=np.int8)  # piolint: disable=PIO305\n"
+    )
+    assert _codes("predictionio_tpu/ops/x.py", suppressed) == []
+
+
 # ---------------------------------------------------------------------------
 # PIO4xx server hygiene
 # ---------------------------------------------------------------------------
