@@ -44,6 +44,7 @@ from predictionio_tpu.analysis import rules_concurrency  # noqa: F401
 from predictionio_tpu.analysis import rules_jax  # noqa: F401
 from predictionio_tpu.analysis import rules_server  # noqa: F401
 from predictionio_tpu.analysis import rules_program  # noqa: F401  (PIO206+)
+from predictionio_tpu.analysis import rules_compile  # noqa: F401  (PIO306+)
 
 __all__ = [
     "DEFAULT_MANIFEST",

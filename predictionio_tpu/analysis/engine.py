@@ -502,6 +502,70 @@ class LintResult:
             "lockOrderCycles": len(self.lock_cycles),
         }
 
+    def to_sarif(self) -> dict:
+        """SARIF 2.1.0 document (``pio lint --format sarif``) so findings
+        render as inline annotations in code-review tooling. New findings
+        are ``error`` (they fail CI), baselined ones ``note`` (accepted
+        debt, still visible in review). URIs are repo-relative posix
+        against the ``SRCROOT`` base — exactly the paths the baseline
+        keys on."""
+        from predictionio_tpu.version import __version__
+
+        def result(f: Finding, level: str) -> dict:
+            text = f.message if not f.detail else f"{f.message} [{f.detail}]"
+            return {
+                "ruleId": f.code,
+                "level": level,
+                "message": {"text": text},
+                "locations": [
+                    {
+                        "physicalLocation": {
+                            "artifactLocation": {
+                                "uri": f.path,
+                                "uriBaseId": "SRCROOT",
+                            },
+                            "region": {"startLine": max(1, f.line)},
+                        }
+                    }
+                ],
+            }
+
+        rules = [
+            {
+                "id": r.code,
+                "name": r.name,
+                "shortDescription": {"text": r.description},
+            }
+            for r in sorted(_RULES.values(), key=lambda r: r.code)
+        ]
+        return {
+            "$schema": "https://raw.githubusercontent.com/oasis-tcs/"
+            "sarif-spec/master/Schemata/sarif-schema-2.1.0.json",
+            "version": "2.1.0",
+            "runs": [
+                {
+                    "tool": {
+                        # no informationUri: SARIF requires an ABSOLUTE
+                        # URI there and piolint has no public homepage —
+                        # schema-validating ingesters reject a relative
+                        # path (rule docs live in docs/development.md)
+                        "driver": {
+                            "name": "piolint",
+                            "version": __version__,
+                            "rules": rules,
+                        }
+                    },
+                    "originalUriBaseIds": {
+                        "SRCROOT": {"uri": f"file://{self.root}/"}
+                    },
+                    "results": [
+                        *(result(f, "error") for f in self.new_findings),
+                        *(result(f, "note") for f in self.baselined),
+                    ],
+                }
+            ],
+        }
+
 
 def default_root() -> str:
     """The repo root when running from a checkout: the parent of the

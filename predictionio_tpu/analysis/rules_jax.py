@@ -17,6 +17,8 @@ property checkable here:
   ``np.array``, ``jax.device_get``, ``.block_until_ready()`` or
   ``float(param)``/``int(param)`` on a traced parameter, inside a
   ``@jax.jit``/``pjit``-decorated function or one of its local helpers.
+  Scope additionally covers ``workflow/device_state.py`` and
+  ``serving/`` — the jit-adjacent layers beside the kernels.
 * ``PIO302`` jit closes over a mutable module global (list/dict/set):
   the traced value is frozen at first compile; later mutation silently
   diverges from the compiled program.
@@ -45,6 +47,18 @@ from typing import Iterator
 from predictionio_tpu.analysis.engine import FileContext, Finding, rule
 
 _SCOPE_PREFIXES = ("predictionio_tpu/ops/", "predictionio_tpu/parallel/")
+
+#: PIO301 additionally covers the jit-adjacent serving layers: the
+#: device_state pin/swap module builds and calls jitted programs behind
+#: the lazy-jax boundary, and serving/ helpers sit next to the batcher
+#: warm-up — a host sync inside a jitted function there is the same
+#: silent dispatch stall it is in ops/ (ISSUE 14 satellite; serving/ is
+#: jax-free by manifest, so the scope is future-proofing: the rule
+#: fires the day someone adds a jitted helper there)
+_PIO301_EXTRA_SCOPE = (
+    "predictionio_tpu/workflow/device_state.py",
+    "predictionio_tpu/serving/",
+)
 
 #: dotted callables that synchronize host and device
 _HOST_SYNC_CALLS = frozenset(
@@ -143,7 +157,9 @@ def _static_param_names(ctx: FileContext, fn: ast.FunctionDef) -> set[str]:
     "host-synchronizing call inside a jit-decorated function",
 )
 def check_host_sync(ctx: FileContext) -> Iterator[Finding]:
-    if not _in_scope(ctx):
+    if not _in_scope(ctx) and not ctx.rel_path.startswith(
+        _PIO301_EXTRA_SCOPE
+    ):
         return
     for fn in _jitted_functions(ctx):
         params = _param_names(fn) - _static_param_names(ctx, fn)

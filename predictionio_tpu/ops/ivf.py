@@ -53,7 +53,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from predictionio_tpu.ops.topk import top_k_permuted
+from predictionio_tpu.ops.topk import bucket_k, top_k_permuted
 
 __all__ = [
     "IVFIndex",
@@ -869,7 +869,7 @@ def query_topk(
     k = min(int(k), index.num_items)
     if k <= 0:
         return [], []
-    kb = min(index.num_items, max(16, 1 << (k - 1).bit_length()))
+    kb = bucket_k(k, index.num_items)
     q = jnp.asarray(np.asarray(qvec, dtype=np.float32)[None, :])
     if runtime.shard_mesh is not None:
         from predictionio_tpu.parallel import sharding

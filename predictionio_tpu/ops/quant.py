@@ -47,7 +47,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from predictionio_tpu.ops.topk import sort_merge_topk
+from predictionio_tpu.ops.topk import bucket_k, sort_merge_topk
 
 __all__ = [
     "QuantizedTable",
@@ -411,7 +411,7 @@ def topk_users(
         else int(item_qt.shape[0])
     )
     k = max(1, min(int(k), num_items))
-    kb = min(num_items, max(16, 1 << (k - 1).bit_length()))
+    kb = bucket_k(k, num_items)
     ids, scores = run_topk(
         runtime, user_qt, item_qt, np.asarray(user_idx, np.int32), kb,
         shards=shards,

@@ -36,7 +36,25 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["top_k_scores", "top_k_permuted", "sort_merge_topk", "top_k_host"]
+__all__ = [
+    "bucket_k",
+    "top_k_scores",
+    "top_k_permuted",
+    "sort_merge_topk",
+    "top_k_host",
+]
+
+
+def bucket_k(k: int, n_items: int, floor: int = 16) -> int:
+    """The ONE pow2 fetch-size bucket every serving tier shares: ``k``
+    rounds up to a power of two (``floor`` minimum), capped at the
+    catalog. Jitted kernels take the bucketed value as their static
+    ``k`` so the compile count is the bucket count, never the request
+    cardinality — piolint PIO306 recognizes this helper (its name
+    contains "bucket") and ``compile-budget.json``'s entries cite its
+    math; changing the floor or rounding here moves every tier's bucket
+    set at once instead of drifting per copy."""
+    return min(int(n_items), max(floor, 1 << (max(1, int(k)) - 1).bit_length()))
 
 
 def sort_merge_topk(

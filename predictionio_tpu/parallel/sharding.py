@@ -53,7 +53,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from predictionio_tpu.ops.compat import shard_map
-from predictionio_tpu.ops.topk import sort_merge_topk
+from predictionio_tpu.ops.topk import bucket_k, sort_merge_topk
 
 __all__ = [
     "MODEL_AXIS",
@@ -512,7 +512,7 @@ def topk_users(
     paths. Returns ``([B, k] ids, [B, k] scores)`` as numpy."""
     num_items = int(info.rows["item"])
     k = max(1, min(int(k), num_items))
-    kb = min(num_items, max(16, 1 << (k - 1).bit_length()))
+    kb = bucket_k(k, num_items)
     idx = jnp.asarray(np.asarray(user_idx, dtype=np.int32))
     ids, scores = sharded_topk_users(
         idx, user_tbl, item_tbl, kb, num_items, info.mesh

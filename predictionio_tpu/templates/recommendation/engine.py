@@ -39,7 +39,7 @@ from predictionio_tpu.templates.serving_util import TOPK_CHUNK
 # re-exported (see __all__): the ranked-result wire types are shared by
 # the similarproduct and ecommerce templates via templates/results.py
 from predictionio_tpu.templates.results import ItemScore, PredictedResult
-from predictionio_tpu.ops.als import ALSConfig, top_k_items, train_als
+from predictionio_tpu.ops.als import ALSConfig, train_als
 
 __all__ = [
     "Query",
@@ -1175,8 +1175,25 @@ class ALSAlgorithm(JaxAlgorithm):
             top, vals = top_k_host(scores, k)
             pairs = [(int(i), float(s)) for i, s in zip(top, vals)]
         else:
-            idx, scores = top_k_items(model.user_factors[uidx], model.item_factors, k)
-            pairs = [(int(i), float(s)) for i, s in zip(np.asarray(idx), np.asarray(scores))]
+            # pinned-device path: k buckets to a power of two (floor 16)
+            # so the jitted selection compiles once per bucket — raw
+            # query.num would key the jit cache at request cardinality
+            # (piolint PIO306; same idiom as ivf.query_topk). Scoring is
+            # a SEPARATE k-independent program (predict_scores) so the
+            # GEMV's float rounding — and therefore tie order vs the
+            # host path — cannot drift with the chosen bucket
+            from predictionio_tpu.ops.als import predict_scores
+            from predictionio_tpu.ops.topk import bucket_k, top_k_scores
+
+            kb = bucket_k(k, int(model.item_factors.shape[0]))
+            dev_scores = predict_scores(
+                model.user_factors[uidx], model.item_factors
+            )
+            idx, scores = top_k_scores(dev_scores, kb)
+            pairs = [
+                (int(i), float(s))
+                for i, s in zip(np.asarray(idx)[:k], np.asarray(scores)[:k])
+            ]
         return PredictedResult(
             tuple(
                 ItemScore(item=model.item_index.inverse(i), score=s) for i, s in pairs

@@ -97,8 +97,9 @@ def chunked_topk(
         int(shards.rows["item"]) if shards is not None
         else int(item_mat.shape[0])
     )
-    k_max = max(k for _, _, k in valid)
-    k_max = min(n_items, max(16, 1 << (k_max - 1).bit_length()))
+    from predictionio_tpu.ops.topk import bucket_k
+
+    k_max = bucket_k(max(k for _, _, k in valid), n_items)
     if ann is not None:
         import jax.numpy as jnp
 

@@ -695,12 +695,21 @@ class TwoTowerAlgorithm(JaxAlgorithm):
             top, vals = top_k_host(scores, k)
             pairs = [(int(i), float(s)) for i, s in zip(top, vals)]
         else:
-            from predictionio_tpu.ops.als import top_k_items
+            # k buckets to a power of two (floor 16) so the jitted
+            # selection compiles once per bucket — raw query.num would
+            # key the jit cache at request cardinality (piolint PIO306).
+            # Scoring runs in the k-independent predict_scores program so
+            # GEMV rounding (and tie order vs the host path) cannot
+            # drift with the chosen bucket
+            from predictionio_tpu.ops.als import predict_scores
+            from predictionio_tpu.ops.topk import bucket_k, top_k_scores
 
-            idx, sc = top_k_items(model.user_vecs[uidx], model.item_vecs, k)
+            kb = bucket_k(k, int(model.item_vecs.shape[0]))
+            dev_scores = predict_scores(model.user_vecs[uidx], model.item_vecs)
+            idx, sc = top_k_scores(dev_scores, kb)
             pairs = [
                 (int(i), float(s))
-                for i, s in zip(np.asarray(idx), np.asarray(sc))
+                for i, s in zip(np.asarray(idx)[:k], np.asarray(sc)[:k])
             ]
         out = []
         for i, score in pairs:

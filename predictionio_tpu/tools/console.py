@@ -557,9 +557,10 @@ def build_parser() -> argparse.ArgumentParser:
         help="tree to lint (default: this checkout's repo root)",
     )
     lint.add_argument(
-        "--format", choices=("text", "json"), default="text",
+        "--format", choices=("text", "json", "sarif"), default="text",
         help="text = file:line diagnostics; json = machine-readable "
-        "summary + findings",
+        "summary + findings; sarif = SARIF 2.1.0 for inline code-review "
+        "annotations (new findings level=error, baselined level=note)",
     )
     lint.add_argument(
         "--baseline", default=None, metavar="FILE",
@@ -574,8 +575,9 @@ def build_parser() -> argparse.ArgumentParser:
     lint.add_argument(
         "--prune-baseline", action="store_true",
         help="drop baseline entries no current finding matches (fixed "
-        "findings) without accepting anything new; CI fails on stale "
-        "entries, this is the one-command cleanup",
+        "findings) AND compile-budget.json entries whose entrypoint no "
+        "longer exists, without accepting anything new; CI fails on "
+        "stale entries, this is the one-command cleanup",
     )
 
     # ---- tsan (runtime lock-witness: predictionio_tpu.analysis.witness)
@@ -600,6 +602,31 @@ def build_parser() -> argparse.ArgumentParser:
         "tsan_args", nargs=argparse.REMAINDER,
         help="command to run under the witness, e.g. "
         "`pio tsan -- chaos-ingest --cycles 1`",
+    )
+
+    # ---- jitwitness (runtime jit-witness: predictionio_tpu.analysis
+    # .jit_witness — the compile/transfer sibling of `pio tsan`)
+    jitw = sub.add_parser(
+        "jitwitness",
+        help="run a pio command under the jit-witness sanitizer: counts "
+        "XLA compiles per call site (with first-compile latency), "
+        "device->host transfer bytes, and per-call jax.jit "
+        "constructions; classifies every static PIO306-308 finding "
+        "CONFIRMED or PLAUSIBLE and checks the compile-budget.json "
+        "ledger (docs/operations.md)",
+    )
+    jitw.add_argument(
+        "--report", default=None, metavar="FILE",
+        help="also write the JSON report to FILE",
+    )
+    jitw.add_argument(
+        "--ledger", default=None, metavar="FILE",
+        help="compile-budget ledger (default: <repo>/compile-budget.json)",
+    )
+    jitw.add_argument(
+        "jitwitness_args", nargs=argparse.REMAINDER,
+        help="command to run under the witness, e.g. "
+        "`pio jitwitness -- batchpredict --input q.json --output o.json`",
     )
 
     # ---- upgrade (informational parity stub)
@@ -1063,8 +1090,26 @@ def main(argv: list[str] | None = None) -> int:
                 update_baseline=args.update_baseline,
                 prune_stale=args.prune_baseline,
             )
+            pruned_ledger = 0
+            if args.prune_baseline:
+                # the compile-budget ledger prunes alongside the finding
+                # baseline: an entrypoint whose file/function is gone is
+                # the same class of stale debt (still stdlib-only — the
+                # prune is an AST existence check)
+                from predictionio_tpu.analysis import jit_witness
+
+                pruned_ledger = jit_witness.prune_ledger(
+                    jit_witness.default_ledger_path(res.root), res.root
+                )
             if args.format == "json":
-                print(json.dumps(res.to_json(), indent=2))
+                payload = res.to_json()
+                # the ledger prune rewrites a checked-in file; a CI job
+                # reading the JSON must see that happened, same as
+                # prunedBaselineEntries
+                payload["prunedCompileBudgetEntries"] = pruned_ledger
+                print(json.dumps(payload, indent=2))
+            elif args.format == "sarif":
+                print(json.dumps(res.to_sarif(), indent=2))
             else:
                 for f in res.new_findings:
                     print(f.render())
@@ -1079,6 +1124,11 @@ def main(argv: list[str] | None = None) -> int:
                         f", {res.pruned_baseline} stale baseline entr"
                         f"{'y' if res.pruned_baseline == 1 else 'ies'} "
                         "pruned"
+                    )
+                if pruned_ledger:
+                    summary += (
+                        f", {pruned_ledger} stale compile-budget entr"
+                        f"{'y' if pruned_ledger == 1 else 'ies'} pruned"
                     )
                 if res.stale_baseline:
                     summary += (
@@ -1127,6 +1177,48 @@ def main(argv: list[str] | None = None) -> int:
             payload["exitCode"] = child_rc
             if args.report:
                 witness.write_report(args.report, payload)
+            print(json.dumps(payload, indent=2, sort_keys=True))
+            return 0 if (payload["ok"] and not child_rc) else 1
+        elif cmd == "jitwitness":
+            # run a nested pio command in-process under the jit-witness
+            # sanitizer (docs/operations.md "Jit-witness runbook"): XLA
+            # compiles per call site, transfer bytes, per-call jit
+            # constructions; classifies the static PIO306-308 findings
+            # and checks the compile-budget ledger. Exit 1 on a budget
+            # VIOLATION or child failure — unbudgeted compiles are
+            # reported, not fatal (arbitrary commands train/cold-start).
+            from predictionio_tpu.analysis import jit_witness
+
+            cmdline = list(args.jitwitness_args)
+            if cmdline and cmdline[0] == "--":
+                cmdline = cmdline[1:]
+            if cmdline and cmdline[0] == "pio":
+                cmdline = cmdline[1:]
+            if not cmdline:
+                print(
+                    "ERROR: pio jitwitness needs a command to execute, "
+                    "e.g. `pio jitwitness -- deploy ...`",
+                    file=sys.stderr,
+                )
+                return 1
+
+            def run_child_jw() -> int:
+                try:
+                    return main(cmdline)
+                except SystemExit as e:
+                    code = e.code
+                    if code is None:
+                        return 0
+                    return code if isinstance(code, int) else 1
+
+            child_rc, rep = jit_witness.run_with_jit_witness(run_child_jw)
+            payload = jit_witness.jitwitness_report(
+                rep, ledger_path=args.ledger
+            )
+            payload["command"] = cmdline
+            payload["exitCode"] = child_rc
+            if args.report:
+                jit_witness.write_report(args.report, payload)
             print(json.dumps(payload, indent=2, sort_keys=True))
             return 0 if (payload["ok"] and not child_rc) else 1
         elif cmd == "chaos-ingest":

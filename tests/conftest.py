@@ -47,6 +47,19 @@ def pytest_addoption(parser):
     )
 
 
+    parser.addoption(
+        "--jit-witness",
+        action="store_true",
+        default=False,
+        help="run the suite under the runtime jit-witness sanitizer "
+        "(predictionio_tpu.analysis.jit_witness): counts XLA compiles "
+        "per call site, device->host transfer bytes and per-call "
+        "jax.jit constructions; classifies every static PIO306-308 "
+        "finding CONFIRMED/PLAUSIBLE at session end. Report lands at "
+        "$PIO_JIT_WITNESS_REPORT (JSON) or the terminal summary.",
+    )
+
+
 def pytest_configure(config):
     if config.getoption("--lock-witness"):
         from predictionio_tpu.analysis import witness
@@ -54,6 +67,12 @@ def pytest_configure(config):
         # install BEFORE any test allocates a lock, so every
         # object constructed during the run is witnessed
         config._lock_witness = witness.install()
+    if config.getoption("--jit-witness"):
+        from predictionio_tpu.analysis import jit_witness
+
+        # install before collection so imports-under-test and fixtures
+        # compile under the witness too
+        config._jit_witness = jit_witness.install()
 
 
 def pytest_sessionfinish(session, exitstatus):
@@ -66,6 +85,35 @@ def pytest_sessionfinish(session, exitstatus):
 
 
 def pytest_unconfigure(config):
+    jw = getattr(config, "_jit_witness", None)
+    if jw is not None:
+        from predictionio_tpu.analysis import jit_witness
+
+        jit_witness.uninstall()
+        rep = jw.report()
+        payload = jit_witness.jitwitness_report(rep)
+        path = os.environ.get("PIO_JIT_WITNESS_REPORT")
+        if path:
+            jit_witness.write_report(path, payload)
+        confirmed = [
+            c
+            for c in payload["staticCompileFindings"]
+            if c["status"] == "CONFIRMED"
+        ]
+        # informational, not a gate: a test suite legitimately compiles
+        # everywhere — the compile-budget gate lives in the bench smoke
+        # guard's WARMED serving window and the compile-count regression
+        # tests, where zero/bounded compiles is a meaningful invariant
+        print(
+            f"\njit-witness: {len(rep.get('compiles', {}))} compile "
+            f"site(s) ({rep.get('totalCompiles', 0)} compiles, "
+            f"{rep.get('totalCompileMs', 0.0):.0f} ms), "
+            f"{len(rep.get('transfers', {}))} transfer site(s) "
+            f"({rep.get('totalTransferBytes', 0)} bytes), "
+            f"{len(payload['staticCompileFindings'])} static PIO306-308 "
+            f"finding(s) ({len(confirmed)} CONFIRMED), "
+            f"{len(payload['budget']['violations'])} budget violation(s)"
+        )
     w = getattr(config, "_lock_witness", None)
     if w is None:
         return

@@ -671,6 +671,19 @@ def test_bench_smoke_runs_green():
         or cache["p99_reduction"] >= 0.30
         or cache["cache_on"]["p50_ms"] * 3 <= cache["cache_off"]["p50_ms"]
     ), f"cache stack shows no win: {cache}"
+    # compile-budget gate (ISSUE 14): the cached run's measured phase is
+    # a WARMED serving path — every witnessed XLA compile must be
+    # budgeted by compile-budget.json (zero unbudgeted) and no budgeted
+    # entrypoint may exceed its max; a retrace regression on the cached
+    # serving path turns the smoke red here
+    jwc = cache.get("jitWitness")
+    assert jwc is not None, "serving_cache lost its jitWitness block"
+    assert jwc["unbudgeted"] == [], (
+        f"unbudgeted compiles in the warmed serving phase: {jwc}"
+    )
+    assert jwc["violations"] == [], (
+        f"compile-budget violations in the warmed serving phase: {jwc}"
+    )
     # resilience section (ISSUE 2 acceptance): through a 2 s injected
     # storage outage under concurrent load there are no raw query 500s,
     # the breaker opens and re-closes, and the probes see the outage and
@@ -934,3 +947,28 @@ def test_bench_smoke_runs_green():
         assert cyc["status"] in ("CONFIRMED", "PLAUSIBLE"), (
             f"unclassified static lock cycle: {cyc}"
         )
+    # runtime jit-witness (ISSUE 14): the serving_cache section's warmed
+    # phase runs under the jit witness, and the lint section must carry
+    # a jitWitness block with every static PIO306-308 finding classified
+    # CONFIRMED/PLAUSIBLE (vacuously none on a clean tree — the fixtures
+    # prove the classifier both ways), the compile-budget ledger
+    # present, and zero budget violations in the capture
+    jwl = lint.get("jitWitness")
+    assert jwl is not None, (
+        "lint section has no jitWitness block — the compile-budget "
+        "story lost its runtime half"
+    )
+    assert jwl["ledger_entries"] >= 10, (
+        f"compile-budget.json collapsed: {jwl}"
+    )
+    for f in jwl["static_findings"]:
+        assert f["status"] in ("CONFIRMED", "PLAUSIBLE"), (
+            f"unclassified static compile finding: {f}"
+        )
+    if jwl["budget"] is not None:
+        assert jwl["budget"]["violations"] == [], (
+            f"compile-budget violations in the witnessed capture: {jwl}"
+        )
+    assert lint["rules"] >= 20, (
+        f"rule registry shrank — PIO306-308 may have fallen out: {lint}"
+    )

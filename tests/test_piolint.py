@@ -1408,7 +1408,9 @@ def test_analysis_package_is_stdlib_only():
             "import sys; import predictionio_tpu.analysis; "
             "import predictionio_tpu.analysis.callgraph; "
             "import predictionio_tpu.analysis.rules_program; "
+            "import predictionio_tpu.analysis.rules_compile; "
             "import predictionio_tpu.analysis.witness; "
+            "import predictionio_tpu.analysis.jit_witness; "
             "bad = [m for m in ('jax', 'numpy') if m in sys.modules]; "
             "sys.exit(1 if bad else 0)",
         ],
@@ -1421,4 +1423,488 @@ def test_analysis_package_is_stdlib_only():
     assert proc.returncode == 0, (
         "importing predictionio_tpu.analysis pulled in jax/numpy:\n"
         + proc.stderr
+    )
+
+
+# ---------------------------------------------------------------------------
+# PIO306–PIO308: whole-program compile/transfer rules (ISSUE 14)
+# ---------------------------------------------------------------------------
+
+_PIO306_KERNEL = """\
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def scored_topk(scores, k):
+    return jax.lax.top_k(scores, k)
+
+
+@jax.jit
+def dense_score(x):
+    return x * 2
+"""
+
+_PIO306_SERVICE = """\
+import numpy as np
+
+from predictionio_tpu.kernels import scored_topk
+
+
+class Service:
+    def handle_query(self, body):
+        k = int(body["num"])
+        return scored_topk(np.zeros((4, 8), np.float32), k)
+"""
+
+
+def test_pio306_unbounded_static_arg():
+    files = {
+        "predictionio_tpu/kernels.py": _PIO306_KERNEL,
+        "predictionio_tpu/svc.py": _PIO306_SERVICE,
+    }
+    found = [f for f in _program_find(files) if f.code == "PIO306"]
+    assert len(found) == 1
+    f = found[0]
+    assert f.path == "predictionio_tpu/svc.py"
+    assert "static arg 'k'" in f.message
+    assert "pow2-bucket" in f.message
+    # the chain is render-only detail, like PIO206's
+    assert f.detail.startswith("via ")
+    assert "handle_query" in f.render()
+
+
+def test_pio306_bucket_step_bounds_the_flow():
+    bucketed = _PIO306_SERVICE.replace(
+        "        return scored_topk(np.zeros((4, 8), np.float32), k)",
+        "        kb = max(16, 1 << (k - 1).bit_length())\n"
+        "        return scored_topk(np.zeros((4, 8), np.float32), kb)",
+    )
+    files = {
+        "predictionio_tpu/kernels.py": _PIO306_KERNEL,
+        "predictionio_tpu/svc.py": bucketed,
+    }
+    assert [c for c in _program_codes(files) if c == "PIO306"] == []
+    # a helper whose NAME says bucket is recognized too (declarative)
+    named = _PIO306_SERVICE.replace(
+        "        return scored_topk(np.zeros((4, 8), np.float32), k)",
+        "        kb = _bucket_for(k)\n"
+        "        return scored_topk(np.zeros((4, 8), np.float32), kb)",
+    )
+    files["predictionio_tpu/svc.py"] = named
+    assert [c for c in _program_codes(files) if c == "PIO306"] == []
+
+
+def test_pio306_config_values_are_not_request_derived():
+    """Values read from self/config attributes are deployment-bounded;
+    only the request roots' parameters seed the taint."""
+    svc = """\
+    import numpy as np
+
+    from predictionio_tpu.kernels import scored_topk
+
+
+    class Service:
+        def handle_query(self, body):
+            return scored_topk(np.zeros((4, 8), np.float32), self.k)
+    """
+    files = {
+        "predictionio_tpu/kernels.py": _PIO306_KERNEL,
+        "predictionio_tpu/svc.py": svc,
+    }
+    assert [c for c in _program_codes(files) if c == "PIO306"] == []
+
+
+def test_pio306_request_derived_shape():
+    """The SHAPE half: an array whose extent tracks request cardinality
+    (``np.zeros((n, 8))`` with ``n = len(bodies)``) retraces the jitted
+    consumer per distinct extent."""
+    svc = """\
+    import numpy as np
+
+    from predictionio_tpu.kernels import dense_score
+
+
+    class Service:
+        def handle_batch(self, bodies):
+            n = len(bodies)
+            x = np.zeros((n, 8), np.float32)
+            return dense_score(x)
+    """
+    files = {
+        "predictionio_tpu/kernels.py": _PIO306_KERNEL,
+        "predictionio_tpu/svc.py": svc,
+    }
+    found = [f for f in _program_find(files) if f.code == "PIO306"]
+    assert len(found) == 1
+    assert "SHAPE" in found[0].message
+    # padding the extent to a bucket bounds it
+    bucketed = svc.replace(
+        "n = len(bodies)", "n = max(16, 1 << (len(bodies) - 1).bit_length())"
+    )
+    files["predictionio_tpu/svc.py"] = bucketed
+    assert [c for c in _program_codes(files) if c == "PIO306"] == []
+
+
+def test_pio306_suppression_and_baseline(tmp_path):
+    suppressed = _PIO306_SERVICE.replace(
+        "        return scored_topk(np.zeros((4, 8), np.float32), k)",
+        "        return scored_topk(np.zeros((4, 8), np.float32), k)"
+        "  # piolint: disable=PIO306",
+    )
+    files = {
+        "predictionio_tpu/kernels.py": _PIO306_KERNEL,
+        "predictionio_tpu/svc.py": suppressed,
+    }
+    assert [c for c in _program_codes(files) if c == "PIO306"] == []
+    found = _program_find(
+        {
+            "predictionio_tpu/kernels.py": _PIO306_KERNEL,
+            "predictionio_tpu/svc.py": _PIO306_SERVICE,
+        }
+    )
+    path = str(tmp_path / "baseline.json")
+    write_baseline(found, path)
+    new, old = split_by_baseline(found, load_baseline(path))
+    assert new == [] and any(f.code == "PIO306" for f in old)
+
+
+_PIO307_FETCH = """\
+import numpy as np
+
+
+def fetch_rows(table, idx):
+    return np.asarray(table)[idx]
+"""
+
+_PIO307_ALGO = """\
+from predictionio_tpu.ops.fetch import fetch_rows
+
+
+class Algo:
+    def predict(self, model, query):
+        return fetch_rows(model, [1])
+"""
+
+
+def test_pio307_transfer_on_serving_path():
+    files = {
+        "predictionio_tpu/ops/fetch.py": _PIO307_FETCH,
+        "predictionio_tpu/algo.py": _PIO307_ALGO,
+    }
+    found = [f for f in _program_find(files) if f.code == "PIO307"]
+    assert len(found) == 1
+    f = found[0]
+    assert f.path == "predictionio_tpu/ops/fetch.py"
+    assert "numpy.asarray" in f.message
+    assert "predict" in f.render()  # the chain, render-only
+    # same module NOT reachable from a request root: out of scope
+    unreached = {
+        "predictionio_tpu/ops/fetch.py": _PIO307_FETCH,
+        "predictionio_tpu/algo.py": _PIO307_ALGO.replace(
+            "def predict", "def train"
+        ),
+    }
+    assert [c for c in _program_codes(unreached) if c == "PIO307"] == []
+    # outside the device-facing scope dirs numpy IS the host path
+    hostside = {
+        "predictionio_tpu/data/fetch.py": _PIO307_FETCH,
+        "predictionio_tpu/algo.py": _PIO307_ALGO.replace(
+            "predictionio_tpu.ops.fetch", "predictionio_tpu.data.fetch"
+        ),
+    }
+    assert [c for c in _program_codes(hostside) if c == "PIO307"] == []
+
+
+def test_pio307_allow_list_and_jitted_bodies():
+    # the device_state pin/swap module is the sanctioned boundary
+    files = {
+        "predictionio_tpu/workflow/device_state.py": _PIO307_FETCH,
+        "predictionio_tpu/algo.py": _PIO307_ALGO.replace(
+            "predictionio_tpu.ops.fetch", "predictionio_tpu.workflow.device_state"
+        ),
+    }
+    assert [c for c in _program_codes(files) if c == "PIO307"] == []
+    # a jit-decorated function's body is PIO301's scope, not PIO307's
+    jitted = """\
+    import jax
+    import numpy as np
+
+
+    @jax.jit
+    def fetch_rows(table, idx):
+        return np.asarray(table)[idx]
+    """
+    files = {
+        "predictionio_tpu/ops/fetch.py": jitted,
+        "predictionio_tpu/algo.py": _PIO307_ALGO,
+    }
+    codes = _program_codes(files)
+    assert "PIO307" not in codes
+    assert "PIO301" in codes  # the per-file rule owns it
+
+
+def test_pio307_suppression_and_baseline(tmp_path):
+    suppressed = _PIO307_FETCH.replace(
+        "    return np.asarray(table)[idx]",
+        "    return np.asarray(table)[idx]  # piolint: disable=PIO307",
+    )
+    files = {
+        "predictionio_tpu/ops/fetch.py": suppressed,
+        "predictionio_tpu/algo.py": _PIO307_ALGO,
+    }
+    assert [c for c in _program_codes(files) if c == "PIO307"] == []
+    found = _program_find(
+        {
+            "predictionio_tpu/ops/fetch.py": _PIO307_FETCH,
+            "predictionio_tpu/algo.py": _PIO307_ALGO,
+        }
+    )
+    path = str(tmp_path / "baseline.json")
+    write_baseline(found, path)
+    new, old = split_by_baseline(found, load_baseline(path))
+    assert new == [] and any(f.code == "PIO307" for f in old)
+
+
+_PIO308_SVC = """\
+import jax
+
+
+class Svc:
+    def handle_query(self, body):
+        f = jax.jit(lambda x: x * 2)
+        return f(body["x"])
+"""
+
+
+def test_pio308_jit_constructed_per_call():
+    found = [
+        f
+        for f in _program_find({"predictionio_tpu/svc.py": _PIO308_SVC})
+        if f.code == "PIO308"
+    ]
+    assert len(found) == 1
+    assert "empty compile cache" in found[0].message
+    # a nested jit-DECORATED def re-evaluates per call too
+    nested = """\
+    import jax
+
+
+    class Svc:
+        def handle_query(self, body):
+            @jax.jit
+            def f(x):
+                return x * 2
+            return f(body["x"])
+    """
+    codes = _program_codes({"predictionio_tpu/svc.py": nested})
+    assert "PIO308" in codes
+    # an UNREACHABLE function may construct freely (one-shot tooling)
+    offline = _PIO308_SVC.replace("handle_query", "export_model")
+    assert "PIO308" not in _program_codes(
+        {"predictionio_tpu/svc.py": offline}
+    )
+
+
+def test_pio308_sanctioned_cache_shapes():
+    # the cached-per-key slot idiom (device_state._sharded_set_rows)
+    slot = """\
+    import jax
+
+    _CACHE = {}
+
+
+    def handle_query(body):
+        key = body["k"]
+        fn = _CACHE.get(key)
+        if fn is None:
+            fn = jax.jit(lambda x: x)
+            _CACHE[key] = fn
+        return fn(1)
+    """
+    assert "PIO308" not in _program_codes({"predictionio_tpu/svc.py": slot})
+    # direct subscript store
+    direct = """\
+    import jax
+
+    _CACHE = {}
+
+
+    def handle_query(body):
+        _CACHE[body["k"]] = jax.jit(lambda x: x)
+        return _CACHE[body["k"]](1)
+    """
+    assert "PIO308" not in _program_codes({"predictionio_tpu/svc.py": direct})
+    # an lru_cache factory memoizes the construction per key
+    factory = """\
+    import functools
+
+    import jax
+
+
+    @functools.lru_cache
+    def compiled(k):
+        return jax.jit(lambda x: x[:k])
+
+
+    def handle_query(body):
+        return compiled(body["n"])(body["x"])
+    """
+    assert "PIO308" not in _program_codes(
+        {"predictionio_tpu/svc.py": factory}
+    )
+
+
+def test_pio308_suppression_and_baseline(tmp_path):
+    suppressed = _PIO308_SVC.replace(
+        "        f = jax.jit(lambda x: x * 2)",
+        "        f = jax.jit(lambda x: x * 2)  # piolint: disable=PIO308",
+    )
+    assert "PIO308" not in _program_codes(
+        {"predictionio_tpu/svc.py": suppressed}
+    )
+    found = _program_find({"predictionio_tpu/svc.py": _PIO308_SVC})
+    path = str(tmp_path / "baseline.json")
+    write_baseline(found, path)
+    new, old = split_by_baseline(found, load_baseline(path))
+    assert new == [] and any(f.code == "PIO308" for f in old)
+
+
+def test_pio301_scope_covers_device_state_and_serving():
+    """ISSUE 14 satellite: PIO301's scope grew to the jit-adjacent
+    layers — workflow/device_state.py and serving/ — beside ops/ and
+    parallel/."""
+    src = """\
+    import jax
+
+    @jax.jit
+    def f(x):
+        return x.item()
+    """
+    assert _codes("predictionio_tpu/workflow/device_state.py", src) == [
+        "PIO301"
+    ]
+    # serving/ is jax-free by manifest, so the same fixture ALSO fires
+    # PIO101 — the scope extension is what adds the PIO301 beside it
+    assert "PIO301" in _codes("predictionio_tpu/serving/helper.py", src)
+    # the rest of workflow/ stays out of scope
+    assert _codes("predictionio_tpu/workflow/core.py", src) == []
+
+
+def test_deleting_a_pow2_bucket_step_is_caught():
+    """Acceptance criterion (ISSUE 14): removing a pow2-bucketing step
+    on a real serving path must fail `pio lint`. Simulated on the REAL
+    sources of the three static-visible bucket sites; the fold-in width
+    bucket (whose taint flows through state-dict mutation the AST
+    analysis cannot see) is covered by the jit-witness compile-count
+    regression tests instead (tests/test_jit_witness.py)."""
+    from predictionio_tpu.analysis.engine import iter_tree_files, lint_sources
+
+    files = {}
+    for abs_path, rel in iter_tree_files(REPO):
+        with open(abs_path, encoding="utf-8", errors="replace") as fh:
+            files[rel.replace(os.sep, "/")] = fh.read()
+    mutations = [
+        (
+            "predictionio_tpu/ops/ivf.py",
+            "kb = bucket_k(k, index.num_items)",
+            "kb = k",
+        ),
+        (
+            "predictionio_tpu/templates/serving_util.py",
+            "k_max = bucket_k(max(k for _, _, k in valid), n_items)",
+            "k_max = min(n_items, max(k for _, _, k in valid))",
+        ),
+        (
+            "predictionio_tpu/templates/recommendation/engine.py",
+            "kb = bucket_k(k, int(model.item_factors.shape[0]))",
+            "kb = k",
+        ),
+    ]
+    baseline = load_baseline(os.path.join(REPO, "piolint-baseline.json"))
+    for path, bucket, raw in mutations:
+        assert bucket in files[path], (
+            f"{path} no longer holds its pow2-bucket step — update this "
+            "guard and the PIO306 acceptance together"
+        )
+        mutated = dict(files)
+        mutated[path] = files[path].replace(bucket, raw)
+        found, _sup, _stats, _cycles = lint_sources(mutated)
+        hits = [f for f in found if f.code == "PIO306"]
+        assert hits, f"deleting the bucket step in {path} went undetected"
+        new, _old = split_by_baseline(found, baseline)
+        assert any(f.code == "PIO306" for f in new), (
+            f"the real baseline masked the {path} bucket deletion"
+        )
+
+
+def test_sarif_output_schema():
+    """`pio lint --format sarif` (ISSUE 14 satellite): a SARIF 2.1.0
+    document whose results carry ruleId/level/message/location, with
+    every ruleId declared in the driver's rule table — the shape
+    code-review tooling needs for inline annotations."""
+    from predictionio_tpu.analysis.engine import LintResult
+
+    res = run_lint(root=REPO)
+    doc = res.to_sarif()
+    assert doc["version"] == "2.1.0"
+    assert "sarif-schema-2.1.0" in doc["$schema"]
+    run = doc["runs"][0]
+    driver = run["tool"]["driver"]
+    assert driver["name"] == "piolint"
+    rule_ids = {r["id"] for r in driver["rules"]}
+    assert {"PIO306", "PIO307", "PIO308"} <= rule_ids
+    for r in driver["rules"]:
+        assert r["shortDescription"]["text"]
+    assert run["originalUriBaseIds"]["SRCROOT"]["uri"].startswith("file://")
+    # a seeded violation produces a level=error result at the right spot
+    seeded = LintResult(
+        root=REPO,
+        files_scanned=1,
+        new_findings=[
+            Finding("PIO306", "predictionio_tpu/x.py", 7, "msg", "via a -> b")
+        ],
+        baselined=[
+            Finding("PIO201", "predictionio_tpu/y.py", 3, "old debt")
+        ],
+        suppressed_count=0,
+        stale_baseline=0,
+    )
+    doc = seeded.to_sarif()
+    results = doc["runs"][0]["results"]
+    assert len(results) == 2
+    err = results[0]
+    assert err["ruleId"] == "PIO306" and err["level"] == "error"
+    loc = err["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"] == "predictionio_tpu/x.py"
+    assert loc["artifactLocation"]["uriBaseId"] == "SRCROOT"
+    assert loc["region"]["startLine"] == 7
+    assert "via a -> b" in err["message"]["text"]
+    note = results[1]
+    assert note["ruleId"] == "PIO201" and note["level"] == "note"
+    # the document is genuinely serializable (what --format sarif prints)
+    json.dumps(doc)
+
+
+def test_pio_lint_sarif_cli(tmp_path):
+    pkg = tmp_path / "predictionio_tpu" / "serving"
+    pkg.mkdir(parents=True)
+    (pkg / "bad.py").write_text("import jax\n")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "predictionio_tpu.tools.console",
+            "lint", "--root", str(tmp_path), "--format", "sarif",
+        ],
+        capture_output=True, text=True, timeout=120, env=env, cwd=REPO,
+    )
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)
+    assert doc["version"] == "2.1.0"
+    results = doc["runs"][0]["results"]
+    assert any(
+        r["ruleId"] == "PIO101" and r["level"] == "error" for r in results
     )
