@@ -2044,6 +2044,37 @@ def _bench_ingest_bulk() -> dict:
         Storage.configure(None)
 
 
+def _bench_serving_fleet() -> dict:
+    """Replica-fleet serving (ISSUE 15): one run of the chaos-serve
+    drill — aggregate q/s vs replica count on this host, tail latency
+    across a replica SIGKILL (zero failed queries, p99 recovered within
+    one breaker reset), a rolling /reload under load (zero
+    cross-generation results, fleet converges to one generation), and
+    one sharded-replica composition point (``--shard-factors`` inside
+    each replica over the 8-way virtual host mesh). Stdlib harness over
+    real ``pio deploy --replicas`` subprocess fleets."""
+    from predictionio_tpu.resilience.chaos import (
+        ServeChaosConfig,
+        run_chaos_serve,
+    )
+
+    cfg = ServeChaosConfig(
+        replicas=int(os.environ.get("BENCH_FLEET_REPLICAS", 2)),
+        clients=int(os.environ.get("BENCH_FLEET_CLIENTS", 16)),
+        kills=int(os.environ.get("BENCH_FLEET_KILLS", 1)),
+        phase_seconds=float(os.environ.get("BENCH_FLEET_SECONDS", 6.0)),
+        reloads=1,
+        train_events=int(os.environ.get("BENCH_FLEET_EVENTS", 400)),
+        train_users=int(os.environ.get("BENCH_FLEET_USERS", 48)),
+        train_items=int(os.environ.get("BENCH_FLEET_ITEMS", 96)),
+        throughput_seconds=float(
+            os.environ.get("BENCH_FLEET_TPUT_SECONDS", 3.0)
+        ),
+        sharded_point=os.environ.get("BENCH_FLEET_SHARD", "1") != "0",
+    )
+    return run_chaos_serve(cfg)
+
+
 def _bench_chaos_ingest(cycles: int, writers: int, events: int) -> dict:
     """Crash-safety drill (ISSUE 5 acceptance): SIGKILL a real event-
     server subprocess >= `cycles` times under concurrent retrying
@@ -3066,6 +3097,19 @@ def main() -> None:
         os.environ["BENCH_SHARD_ITEMS"] = "16384,131072"
         os.environ["BENCH_SHARD_RANK"] = "32"
         os.environ["BENCH_SHARD_QUERIES"] = "1024"
+        # replica-fleet drill (ISSUE 15): tiny model, R in {1,2}, one
+        # SIGKILL + one rolling reload under 16 clients, plus the
+        # sharded-replica point — ~60 s of real subprocess fleets
+        os.environ["BENCH_FLEET"] = "1"
+        os.environ["BENCH_FLEET_REPLICAS"] = "2"
+        os.environ["BENCH_FLEET_CLIENTS"] = "16"
+        os.environ["BENCH_FLEET_KILLS"] = "1"
+        os.environ["BENCH_FLEET_SECONDS"] = "5"
+        os.environ["BENCH_FLEET_EVENTS"] = "300"
+        os.environ["BENCH_FLEET_USERS"] = "40"
+        os.environ["BENCH_FLEET_ITEMS"] = "80"
+        os.environ["BENCH_FLEET_TPUT_SECONDS"] = "2"
+        os.environ["BENCH_FLEET_SHARD"] = "1"
         os.environ.pop("BENCH_PRECISION_COMPARE", None)
         # fresh compile cache: a persistent cache populated on a different
         # host can carry AOT results whose CPU features mismatch (SIGILL risk)
@@ -3220,6 +3264,12 @@ def main() -> None:
             )
         except Exception as e:
             detail["chaos_ingest"] = {"error": str(e)[:300]}
+
+    if os.environ.get("BENCH_FLEET", "1") != "0":
+        try:
+            detail["serving_fleet"] = _bench_serving_fleet()
+        except Exception as e:
+            detail["serving_fleet"] = {"error": str(e)[:300]}
 
     if os.environ.get("BENCH_LINT", "1") != "0":
         try:

@@ -94,6 +94,22 @@ DEFAULT_MANIFEST: Manifest = (
         "it)",
     ),
     PackageRule(
+        package="predictionio_tpu/fleet",
+        stdlib_only=True,
+        allow=(
+            "predictionio_tpu.fleet",
+            "predictionio_tpu.resilience",
+            "predictionio_tpu.serving.cache",
+            "predictionio_tpu.api.http",
+            "predictionio_tpu.api.lifecycle",
+        ),
+        reason="the replica fleet (router, supervisor, registry) is host "
+        "orchestration over HTTP: replicas are opaque processes behind "
+        "URLs, so the layer must run with no jax/numpy/storage/workflow "
+        "imports — only the equally stdlib-only resilience primitives, "
+        "the HTTP transport, and serving.cache's key helpers",
+    ),
+    PackageRule(
         package="predictionio_tpu/api/lifecycle.py",
         stdlib_only=True,
         reason="graceful drain/shutdown must work on every server with no "

@@ -1,0 +1,66 @@
+"""Replica-fleet serving: router, health gating, rolling model swap.
+
+One process — even a multi-device mesh process (``--shard-factors``) —
+is still one SIGKILL away from an outage. This package composes **R
+replicas** behind a router so the served product survives any single
+replica dying, reloading, or draining (ROADMAP item 1; the serving-fleet
+topology of PredictionIO's query-server tier, scaled the way ALX scales
+model-parallel serving beyond one host — PAPERS.md):
+
+* :mod:`predictionio_tpu.fleet.ring` — consistent-hash-by-cache-scope
+  routing, so PR 4's result cache *shards* across replicas instead of
+  duplicating (a scope's repeated queries always land on the same
+  replica) and a membership change remaps only ~1/R of scopes;
+* :mod:`predictionio_tpu.fleet.router` — the router process behind
+  ``pio deploy --replicas N``: per-replica health tracking (active
+  ``/readyz`` probes + passive failure counting + a
+  :class:`~predictionio_tpu.resilience.CircuitBreaker` per backend),
+  bounded same-query failover for idempotent requests, ``Retry-After``-
+  aware draining avoidance, optional p95-triggered hedged requests,
+  invalidation broadcast, and router-orchestrated rolling ``/reload``;
+* :mod:`predictionio_tpu.fleet.registry` — a generation-stamped model
+  registry over shared-filesystem storage, so every replica of a fleet
+  (and every fleet of a cluster) agrees on which model generation is
+  being rolled out;
+* :mod:`predictionio_tpu.fleet.supervisor` — spawns the N query-server
+  subprocesses, respawns any that die, and records the fleet topology
+  where operators (``pio status``) and the chaos drill
+  (``pio chaos-serve``) can find it.
+
+Stdlib-only by contract (piolint manifest): the fleet layer is host
+orchestration over HTTP and must run with no jax, numpy, or storage
+imports — replicas are opaque processes behind URLs. The only framework
+imports allowed are the equally stdlib-only resilience primitives, the
+HTTP transport, and ``serving.cache``'s key helpers. Everything is
+strictly opt-in: without ``--replicas`` nothing here is ever imported
+and serving is byte-identical (tests/test_ci_guards.py).
+"""
+
+from __future__ import annotations
+
+from predictionio_tpu.fleet.registry import ModelRegistry, RegistryRecord
+from predictionio_tpu.fleet.ring import HashRing
+from predictionio_tpu.fleet.router import (
+    ReplicaState,
+    RouterConfig,
+    RouterService,
+)
+from predictionio_tpu.fleet.supervisor import (
+    FleetSupervisor,
+    ReplicaSpec,
+    fleet_state_path,
+    read_fleet_state,
+)
+
+__all__ = [
+    "FleetSupervisor",
+    "HashRing",
+    "ModelRegistry",
+    "RegistryRecord",
+    "ReplicaSpec",
+    "ReplicaState",
+    "RouterConfig",
+    "RouterService",
+    "fleet_state_path",
+    "read_fleet_state",
+]

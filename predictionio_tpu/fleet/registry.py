@@ -1,0 +1,147 @@
+"""Generation-stamped model registry over shared-filesystem storage.
+
+A fleet needs one answer to "which model should every replica be
+serving?". Each replica's in-process reload counter says where *that
+process* is; the registry says where the *fleet* should converge. It is
+a single JSON document on a filesystem every replica host mounts (the
+same sharedfs idiom the storage layer's ``TYPE=sharedfs`` driver uses):
+
+* ``publish(instance_id)`` — stamp a new fleet generation pointing at a
+  trained engine instance. Atomic (tmp + fsync + rename) so a reader
+  never sees a torn record; the generation counter is monotonic even
+  across concurrent publishers (last writer wins the pointer, but never
+  reuses a generation number).
+* ``current()`` — the record replicas/routers/operators gate on.
+* ``history()`` — recent generations, newest first (bounded), so a
+  rollback target is always one read away.
+
+The router's rolling ``/reload`` stamps the registry before rotating
+replicas, then verifies every replica reports the fleet generation on
+``/readyz`` — "rollout complete" is a registry⇄fleet convergence check,
+not a hope (docs/operations.md, fleet runbook).
+
+Stdlib-only by contract: the registry must be readable from the router,
+``pio status``, and CI hosts with nothing installed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime as _dt
+import json
+import os
+import tempfile
+from typing import Any
+
+__all__ = ["ModelRegistry", "RegistryRecord"]
+
+_HISTORY_LIMIT = 50
+
+
+@dataclasses.dataclass(frozen=True)
+class RegistryRecord:
+    """One published fleet generation."""
+
+    generation: int
+    engine_instance_id: str
+    published_at: str  # ISO-8601 UTC
+    meta: dict | None = None
+
+    def to_json(self) -> dict:
+        out: dict[str, Any] = {
+            "generation": self.generation,
+            "engineInstanceId": self.engine_instance_id,
+            "publishedAt": self.published_at,
+        }
+        if self.meta:
+            out["meta"] = dict(self.meta)
+        return out
+
+    @staticmethod
+    def from_json(d: dict) -> "RegistryRecord":
+        return RegistryRecord(
+            generation=int(d["generation"]),
+            engine_instance_id=str(d["engineInstanceId"]),
+            published_at=str(d.get("publishedAt", "")),
+            meta=d.get("meta"),
+        )
+
+
+class ModelRegistry:
+    """The fleet's model-generation ledger at ``<dir>/model-registry.json``."""
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        self.path = os.path.join(directory, "model-registry.json")
+
+    # --------------------------------------------------------------- read
+    def _load(self) -> dict:
+        try:
+            with open(self.path) as f:
+                doc = json.load(f)
+        except FileNotFoundError:
+            return {"current": None, "history": []}
+        except (json.JSONDecodeError, OSError):
+            # a torn read can only happen if rename atomicity was violated
+            # (non-POSIX mount): treat as empty rather than wedging the
+            # fleet on a parse error; the next publish rewrites it whole
+            return {"current": None, "history": []}
+        if not isinstance(doc, dict):
+            return {"current": None, "history": []}
+        return doc
+
+    def current(self) -> RegistryRecord | None:
+        cur = self._load().get("current")
+        if not isinstance(cur, dict):
+            return None
+        try:
+            return RegistryRecord.from_json(cur)
+        except (KeyError, ValueError, TypeError):
+            return None
+
+    def history(self) -> list[RegistryRecord]:
+        out = []
+        for d in self._load().get("history", []):
+            try:
+                out.append(RegistryRecord.from_json(d))
+            except (KeyError, ValueError, TypeError):
+                continue
+        return out
+
+    # -------------------------------------------------------------- write
+    def publish(
+        self, engine_instance_id: str, meta: dict | None = None
+    ) -> RegistryRecord:
+        """Stamp the next fleet generation. Atomic rename; fsync'd so an
+        acked publish survives a host crash (same durability contract as
+        the model blobs it points at)."""
+        doc = self._load()
+        prev = doc.get("current") or {}
+        generation = int(prev.get("generation", 0)) + 1
+        record = RegistryRecord(
+            generation=generation,
+            engine_instance_id=engine_instance_id,
+            published_at=_dt.datetime.now(_dt.timezone.utc).isoformat(),
+            meta=meta,
+        )
+        history = [record.to_json()] + list(doc.get("history", []))
+        new_doc = {
+            "current": record.to_json(),
+            "history": history[:_HISTORY_LIMIT],
+        }
+        os.makedirs(self.directory, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(
+            prefix=".model-registry.", suffix=".tmp", dir=self.directory
+        )
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump(new_doc, f, indent=2)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.path)
+        finally:
+            try:
+                os.unlink(tmp)
+            except FileNotFoundError:
+                pass
+        return record

@@ -56,7 +56,13 @@ from typing import Any
 
 from predictionio_tpu.resilience.faults import FaultError, FaultInjector
 
-__all__ = ["ChaosConfig", "ChaosError", "run_chaos_ingest"]
+__all__ = [
+    "ChaosConfig",
+    "ChaosError",
+    "ServeChaosConfig",
+    "run_chaos_ingest",
+    "run_chaos_serve",
+]
 
 _ACCESS_KEY = "chaos-ingest-key"
 _APP_NAME = "chaosapp"
@@ -833,5 +839,735 @@ def run_chaos_ingest(cfg: ChaosConfig) -> dict:
         and drain.get("exitCode") == 0
         and drain.get("raw500s") == 0
         and drain.get("withinDeadline")
+    )
+    return report
+
+
+# ---------------------------------------------------------------------------
+# Serving-fleet chaos (``pio chaos-serve``; ISSUE 15)
+# ---------------------------------------------------------------------------
+#
+# The ingest drill above proves writes survive a SIGKILL; this drill
+# proves *reads never notice one*. It trains a tiny real model, deploys
+# it as ``pio deploy --replicas N`` (router + replica subprocesses), and
+# then, with >= 16 concurrent query clients that NEVER retry:
+#
+# 1. **throughput** — aggregate q/s at each fleet size (the bench's
+#    q/s-vs-R curve; one core can't show scaling, so the report carries
+#    cpuCount and a one-core note instead of a fake ratio);
+# 2. **kill** — SIGKILL a replica mid-traffic. The router must route
+#    around it within one probe interval and retry the in-flight
+#    casualties on a peer, so every client request still answers 2xx
+#    (zero failed queries), and tail latency must recover within one
+#    breaker-reset interval. The supervisor respawns the replica and the
+#    fleet heals to full strength;
+# 3. **rolling** — ``POST /reload`` on the router rotates the fleet one
+#    replica at a time while clients keep querying: zero failed queries,
+#    zero cross-generation results for any one cache scope (each client
+#    owns disjoint scopes, so per-scope generation monotonicity is exact,
+#    not racy), and the fleet converges to one generation;
+# 4. optionally one **sharded-replica** fleet (``--shard-factors`` inside
+#    each replica over the 8-way virtual host mesh) — the R x S
+#    composition point.
+#
+# Same contract as the ingest drill: stdlib-only, everything over the
+# wire and the filesystem (the supervisor's fleet state file names the
+# replica PIDs to kill); verdicts are asserted fields, never log lines.
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeChaosConfig:
+    """Knobs of one serving-fleet chaos run (CLI: ``pio chaos-serve``)."""
+
+    replicas: int = 2
+    clients: int = 16
+    kills: int = 1
+    phase_seconds: float = 6.0
+    reloads: int = 1
+    #: synthetic `rate` events the tiny model trains on
+    train_events: int = 400
+    train_users: int = 60
+    train_items: int = 120
+    rank: int = 8
+    iterations: int = 2
+    seed: int = 0
+    #: fleet sizes of the aggregate-q/s sweep (the last one is reused
+    #: for the kill/rolling phases when it matches ``replicas``)
+    throughput_replicas: tuple[int, ...] = (1, 2)
+    throughput_seconds: float = 3.0
+    #: also measure one fleet whose replicas serve ``--shard-factors``
+    sharded_point: bool = False
+    probe_interval_s: float = 0.25
+    breaker_reset_s: float = 1.0
+    query_timeout_s: float = 20.0
+    startup_timeout_s: float = 180.0
+    total_timeout_s: float = 900.0
+    base_dir: str | None = None
+    keep_dir: bool = False
+
+    def __post_init__(self) -> None:
+        if self.replicas < 1 or self.clients < 1:
+            raise ValueError("replicas and clients must be >= 1")
+
+
+def _run_pio(env: dict, args: list[str], timeout_s: float, what: str) -> str:
+    proc = subprocess.run(
+        [sys.executable, "-m", "predictionio_tpu.tools.console", *args],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=timeout_s,
+    )
+    if proc.returncode != 0:
+        raise ChaosError(
+            f"{what} failed rc={proc.returncode}: {proc.stderr[-800:]}"
+        )
+    return proc.stdout
+
+
+class _FleetProc:
+    """One ``pio deploy --replicas N`` subprocess tree (router +
+    supervised replicas) plus the wire/file helpers the drill needs."""
+
+    def __init__(
+        self,
+        env: dict,
+        base: str,
+        engine_json: str,
+        replicas: int,
+        cfg: ServeChaosConfig,
+        extra_args: tuple[str, ...] = (),
+        env_extra: dict | None = None,
+    ):
+        self.port = _free_port()
+        self.base = base
+        self.replicas = replicas
+        run_env = dict(env)
+        # the bench parent forces an 8-virtual-device XLA host platform
+        # for its sharding sections; a plain replica must not inherit it
+        # (the sharded point passes its own via env_extra)
+        run_env.pop("XLA_FLAGS", None)
+        if env_extra:
+            run_env.update(env_extra)
+        self.proc = subprocess.Popen(
+            [
+                sys.executable, "-m", "predictionio_tpu.tools.console",
+                "deploy",
+                "--engine-json", engine_json,
+                "--ip", "127.0.0.1",
+                "--port", str(self.port),
+                "--replicas", str(replicas),
+                "--probe-interval-s", str(cfg.probe_interval_s),
+                "--failover-retries", "1",
+                "--fleet-breaker-threshold", "2",
+                "--fleet-breaker-reset-s", str(cfg.breaker_reset_s),
+                "--result-cache", "--coalesce",
+                *extra_args,
+            ],
+            env=run_env,
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        )
+
+    @property
+    def state_path(self) -> str:
+        return os.path.join(
+            self.base, "deployments", f"fleet-{self.port}.json"
+        )
+
+    def state(self) -> dict | None:
+        try:
+            with open(self.state_path) as f:
+                doc = json.load(f)
+        except (FileNotFoundError, json.JSONDecodeError, OSError):
+            return None
+        return doc if isinstance(doc, dict) else None
+
+    def status(self) -> dict | None:
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{self.port}/", timeout=5
+            ) as resp:
+                return json.loads(resp.read())
+        except Exception:
+            return None
+
+    def wait_all_ready(self, timeout_s: float) -> float:
+        """Until EVERY replica is healthy at the router (throughput
+        phases must start at full strength); returns seconds waited."""
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < timeout_s:
+            if self.proc.poll() is not None:
+                raise ChaosError(
+                    f"fleet exited rc={self.proc.returncode} before ready"
+                )
+            status = self.status()
+            if status is not None:
+                reps = status.get("replicas", [])
+                if reps and all(r.get("healthy") for r in reps):
+                    return time.monotonic() - t0
+            time.sleep(0.1)
+        raise ChaosError(f"fleet not fully ready within {timeout_s:g}s")
+
+    def kill_replica(self, index: int) -> tuple[str, int]:
+        """SIGKILL replica ``index`` by the PID in the supervisor's state
+        file; returns (replica id, pid killed)."""
+        state = self.state()
+        if state is None:
+            raise ChaosError("no fleet state file to pick a victim from")
+        reps = state.get("replicas", [])
+        rep = reps[index % len(reps)]
+        pid = rep.get("pid")
+        if not pid:
+            raise ChaosError(f"replica {rep.get('id')} has no pid on file")
+        os.kill(int(pid), signal.SIGKILL)
+        return str(rep.get("id")), int(pid)
+
+    def wait_respawn(self, replica_id: str, old_pid: int, timeout_s: float) -> bool:
+        """Until the supervisor has a NEW live pid for ``replica_id`` and
+        the router reports it healthy again."""
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < timeout_s:
+            state = self.state() or {}
+            rep = next(
+                (
+                    r
+                    for r in state.get("replicas", [])
+                    if r.get("id") == replica_id
+                ),
+                None,
+            )
+            if rep and rep.get("alive") and rep.get("pid") != old_pid:
+                status = self.status() or {}
+                srep = next(
+                    (
+                        r
+                        for r in status.get("replicas", [])
+                        if r.get("id") == replica_id
+                    ),
+                    None,
+                )
+                if srep and srep.get("healthy"):
+                    return True
+            time.sleep(0.1)
+        return False
+
+    def reload(self, timeout_s: float) -> dict:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{self.port}/reload",
+            data=b"{}",
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+                return json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            try:
+                return json.loads(e.read())
+            except Exception:
+                return {"ok": False, "error": f"HTTP {e.code}"}
+
+    def router_stats(self) -> dict | None:
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{self.port}/stats.json", timeout=5
+            ) as resp:
+                return json.loads(resp.read())
+        except Exception:
+            return None
+
+    def stop(self) -> None:
+        """SIGTERM the supervisor (it takes its replicas down), escalate
+        if needed, and reap any replica pid still on file."""
+        pids: list[int] = []
+        state = self.state()
+        if state:
+            pids = [
+                int(r["pid"])
+                for r in state.get("replicas", [])
+                if r.get("pid")
+            ]
+        if self.proc.poll() is None:
+            self.proc.send_signal(signal.SIGTERM)
+            try:
+                self.proc.wait(timeout=20)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                try:
+                    self.proc.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    pass
+        for pid in pids:  # belt-and-braces: no replica outlives the drill
+            try:
+                os.kill(pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+
+
+class _QueryClients:
+    """Concurrent query clients that NEVER retry — zero failed queries
+    means the ROUTER absorbed every fault, not the clients. Client ``i``
+    queries only users ``u`` with ``u % clients == i``: disjoint cache
+    scopes per client, so each scope's responses are observed strictly
+    in order and per-scope generation monotonicity is exact."""
+
+    def __init__(self, port: int, cfg: ServeChaosConfig):
+        self.port = port
+        self.cfg = cfg
+        self.stop = threading.Event()
+        self._lock = threading.Lock()
+        #: (t_done_monotonic, latency_s, status, scope, generation)
+        self.samples: list[tuple[float, float, int, str, int]] = []
+        self.transport_errors = 0
+        self._threads = [
+            threading.Thread(
+                target=self._run, args=(i,), daemon=True,
+                name=f"chaos-serve-client-{i}",
+            )
+            for i in range(cfg.clients)
+        ]
+
+    def start(self) -> None:
+        for t in self._threads:
+            t.start()
+
+    def join(self, timeout_s: float = 30.0) -> None:
+        self.stop.set()
+        deadline = time.monotonic() + timeout_s
+        for t in self._threads:
+            t.join(timeout=max(0.1, deadline - time.monotonic()))
+
+    def _run(self, cid: int) -> None:
+        cfg = self.cfg
+        users = [
+            f"u{u}" for u in range(cfg.train_users) if u % cfg.clients == cid
+        ] or [f"u{cid % cfg.train_users}"]
+        rng = random.Random(cfg.seed * 7919 + cid)
+        url = f"http://127.0.0.1:{self.port}/queries.json"
+        while not self.stop.is_set():
+            user = users[rng.randrange(len(users))]
+            payload = json.dumps({"user": user, "num": 4}).encode()
+            req = urllib.request.Request(
+                url,
+                data=payload,
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            t0 = time.monotonic()
+            status = 0
+            generation = 0
+            try:
+                with urllib.request.urlopen(
+                    req, timeout=cfg.query_timeout_s
+                ) as resp:
+                    resp.read()
+                    status = resp.status
+                    generation = int(
+                        resp.headers.get("X-PIO-Generation", "0") or 0
+                    )
+            except urllib.error.HTTPError as e:
+                e.read()
+                status = e.code
+            except Exception:
+                with self._lock:
+                    self.transport_errors += 1
+                continue
+            t1 = time.monotonic()
+            with self._lock:
+                self.samples.append((t1, t1 - t0, status, user, generation))
+
+    # ----------------------------------------------------------- analysis
+    def snapshot(self) -> list[tuple[float, float, int, str, int]]:
+        with self._lock:
+            return list(self.samples)
+
+    @staticmethod
+    def _p99(latencies: list[float]) -> float | None:
+        if not latencies:
+            return None
+        lat = sorted(latencies)
+        return lat[min(len(lat) - 1, int(0.99 * len(lat)))]
+
+    def summarize(self, t_start: float, t_end: float) -> dict:
+        samples = [s for s in self.snapshot() if t_start <= s[0] <= t_end]
+        lat = sorted(s[1] for s in samples)
+        failed = [s for s in samples if not 200 <= s[2] < 300]
+        duration = max(1e-6, t_end - t_start)
+        return {
+            "requests": len(samples),
+            "failed": len(failed),
+            "failedStatuses": sorted({s[2] for s in failed}),
+            "transportErrors": self.transport_errors,
+            "qps": round(len(samples) / duration, 1),
+            "p50Ms": round(lat[len(lat) // 2] * 1000, 3) if lat else None,
+            "p99Ms": round(self._p99([s[1] for s in samples]) * 1000, 3)
+            if lat
+            else None,
+        }
+
+    def cross_generation_violations(self) -> int:
+        """Per scope, the generation sequence (in completion order —
+        exact, because scopes are client-disjoint) must never decrease:
+        one cache key served by gen g+1 must never be served by gen g
+        again."""
+        last: dict[str, int] = {}
+        violations = 0
+        for _t, _lat, status, scope, gen in self.snapshot():
+            if not 200 <= status < 300 or gen <= 0:
+                continue
+            if gen < last.get(scope, 0):
+                violations += 1
+            else:
+                last[scope] = gen
+        return violations
+
+
+def _serve_setup(env: dict, base: str, cfg: ServeChaosConfig) -> str:
+    """App + synthetic events + one trained instance; returns the
+    engine.json path. All through real ``pio`` subprocesses — the drill
+    exercises the product path end to end."""
+    _setup_app(env)
+    rng = random.Random(cfg.seed)
+    events_path = os.path.join(base, "train-events.jsonl")
+    with open(events_path, "w") as f:
+        for i in range(cfg.train_events):
+            u = i % cfg.train_users
+            f.write(
+                json.dumps(
+                    {
+                        "event": "rate",
+                        "entityType": "user",
+                        "entityId": f"u{u}",
+                        "targetEntityType": "item",
+                        "targetEntityId": f"i{rng.randrange(cfg.train_items)}",
+                        "properties": {"rating": float(1 + rng.randrange(5))},
+                        "eventTime": "2024-01-01T00:00:00.000Z",
+                    }
+                )
+                + "\n"
+            )
+    _run_pio(
+        env,
+        ["import", "--appname", _APP_NAME, "--input", events_path],
+        cfg.startup_timeout_s,
+        "event import",
+    )
+    engine_json = os.path.join(base, "engine.json")
+    with open(engine_json, "w") as f:
+        json.dump(
+            {
+                "id": "fleet-chaos",
+                "version": "1",
+                "engineFactory": (
+                    "predictionio_tpu.templates.recommendation:engine_factory"
+                ),
+                "datasource": {"params": {"appName": _APP_NAME}},
+                "algorithms": [
+                    {
+                        "name": "als",
+                        "params": {
+                            "rank": cfg.rank,
+                            "numIterations": cfg.iterations,
+                            "lambda": 0.05,
+                        },
+                    }
+                ],
+            },
+            f,
+        )
+    _run_pio(
+        env,
+        ["train", "--engine-json", engine_json, "--mesh", "none"],
+        cfg.startup_timeout_s * 2,  # first train pays the XLA compile
+        "train",
+    )
+    return engine_json
+
+
+def _warm_fleet(port: int, cfg: ServeChaosConfig, distinct_users: int = 8) -> None:
+    """Sequential warm-up queries before any measured (or asserted)
+    window: the first queries after a (re)deploy pay jit warm-up — on
+    the sharded path tens of seconds of XLA compile — and 16 concurrent
+    cold clients would read as timeouts, not as fleet behavior. Distinct
+    users spread the warm-up across the hash ring so every replica gets
+    touched."""
+    for u in range(distinct_users):
+        payload = json.dumps({"user": f"u{u}", "num": 4}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/queries.json",
+            data=payload,
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        deadline = time.monotonic() + cfg.startup_timeout_s
+        while time.monotonic() < deadline:
+            try:
+                with urllib.request.urlopen(
+                    req, timeout=cfg.startup_timeout_s
+                ) as resp:
+                    resp.read()
+                break
+            except urllib.error.HTTPError as e:
+                e.read()
+                break  # the fleet answered; warm enough for this user
+            except Exception:
+                time.sleep(0.2)
+
+
+def _throughput_point(
+    env: dict,
+    base: str,
+    engine_json: str,
+    cfg: ServeChaosConfig,
+    replicas: int,
+    extra_args: tuple[str, ...] = (),
+    env_extra: dict | None = None,
+    keep_fleet: bool = False,
+    clients_override: int | None = None,
+) -> tuple[dict, "_FleetProc | None"]:
+    """Measure aggregate q/s at one fleet size; optionally hand the live
+    fleet back for the next phase instead of stopping it."""
+    fleet = _FleetProc(
+        env, base, engine_json, replicas, cfg,
+        extra_args=extra_args, env_extra=env_extra,
+    )
+    if clients_override is not None:
+        cfg = dataclasses.replace(cfg, clients=clients_override)
+    try:
+        ready_s = fleet.wait_all_ready(cfg.startup_timeout_s)
+        _warm_fleet(fleet.port, cfg)
+        clients = _QueryClients(fleet.port, cfg)
+        clients.start()
+        t0 = time.monotonic()
+        time.sleep(cfg.throughput_seconds)
+        t1 = time.monotonic()
+        clients.join()
+        point = dict(
+            clients.summarize(t0, t1),
+            replicas=replicas,
+            clients=cfg.clients,
+            readySeconds=round(ready_s, 2),
+        )
+    except BaseException:
+        fleet.stop()
+        raise
+    if keep_fleet:
+        return point, fleet
+    fleet.stop()
+    return point, None
+
+
+def _kill_phase(fleet: "_FleetProc", cfg: ServeChaosConfig) -> dict:
+    """SIGKILL replicas under load; zero failed queries, p99 recovery
+    within one breaker reset, supervisor respawn back to full strength."""
+    clients = _QueryClients(fleet.port, cfg)
+    clients.start()
+    t0 = time.monotonic()
+    warm_s = max(0.5, cfg.phase_seconds * 0.25)
+    time.sleep(warm_s)
+    kill_records = []
+    for k in range(cfg.kills):
+        t_kill = time.monotonic()
+        rid, pid = fleet.kill_replica(k % fleet.replicas)
+        respawned = fleet.wait_respawn(
+            rid, pid, timeout_s=cfg.startup_timeout_s
+        )
+        kill_records.append(
+            {
+                "replica": rid,
+                "pid": pid,
+                "tKill": t_kill,
+                "respawned": respawned,
+            }
+        )
+    # post-kill observation window: at least one breaker reset + probes
+    recovery_budget = cfg.breaker_reset_s + 2 * cfg.probe_interval_s
+    tail_s = max(cfg.phase_seconds - (time.monotonic() - t0), recovery_budget + 1.0)
+    time.sleep(tail_s)
+    t_end = time.monotonic()
+    clients.join()
+    overall = clients.summarize(t0, t_end)
+    first_kill = kill_records[0]["tKill"] if kill_records else t0
+    last_kill = kill_records[-1]["tKill"] if kill_records else t0
+    baseline = clients.summarize(t0 + warm_s * 0.5, first_kill)
+    recovered_window = clients.summarize(
+        last_kill + recovery_budget, t_end
+    )
+    base_p99 = baseline.get("p99Ms")
+    rec_p99 = recovered_window.get("p99Ms")
+    # one-core honesty (and scheduler jitter generally): the recovery
+    # claim uses a floor — "back under 3x the pre-kill p99, or under an
+    # absolute 250 ms" — so a microsecond-fast baseline cannot turn
+    # noise into a red verdict, while a breaker/probe regression (seconds
+    # of stall) still fails loudly
+    p99_recovered = (
+        rec_p99 is not None
+        and base_p99 is not None
+        and (rec_p99 <= 3 * base_p99 or rec_p99 <= 250.0)
+    )
+    return {
+        "kills": [
+            {"replica": r["replica"], "respawned": r["respawned"]}
+            for r in kill_records
+        ],
+        "killCount": len(kill_records),
+        "allRespawned": all(r["respawned"] for r in kill_records),
+        "overall": overall,
+        "baselineWindow": baseline,
+        "recoveredWindow": recovered_window,
+        "recoveryBudgetSeconds": round(recovery_budget, 3),
+        "p99Recovered": bool(p99_recovered),
+        "failedQueries": overall["failed"] + overall["transportErrors"],
+    }
+
+
+def _rolling_phase(fleet: "_FleetProc", cfg: ServeChaosConfig) -> dict:
+    """Rolling /reload under load: zero failed queries, zero
+    cross-generation results per cache scope, fleet converges."""
+    clients = _QueryClients(fleet.port, cfg)
+    clients.start()
+    t0 = time.monotonic()
+    time.sleep(0.5)
+    reload_reports = []
+    for _ in range(max(1, cfg.reloads)):
+        reload_reports.append(fleet.reload(timeout_s=cfg.startup_timeout_s))
+    time.sleep(1.0)
+    t_end = time.monotonic()
+    clients.join()
+    overall = clients.summarize(t0, t_end)
+    stats = fleet.router_stats() or {}
+    return {
+        "overall": overall,
+        "reloads": reload_reports,
+        "reloadsOk": all(r.get("ok") for r in reload_reports),
+        "converged": all(r.get("converged") for r in reload_reports),
+        "crossGenerationViolations": clients.cross_generation_violations(),
+        "routerGenerationRegressions": (
+            (stats.get("router") or {}).get("generationRegressions")
+        ),
+        "failedQueries": overall["failed"] + overall["transportErrors"],
+    }
+
+
+def run_chaos_serve(cfg: ServeChaosConfig) -> dict:
+    """Run the full serving-fleet drill; returns the report dict
+    (``report["ok"]`` is the overall verdict — the CLI exit code and the
+    bench ``serving_fleet`` smoke guard key off the individual fields)."""
+    base = cfg.base_dir or tempfile.mkdtemp(prefix="pio_chaos_serve_")
+    os.makedirs(base, exist_ok=True)
+    env = _storage_env(base, "sqlite")
+    report: dict[str, Any] = {
+        "replicas": cfg.replicas,
+        "clients": cfg.clients,
+        "seed": cfg.seed,
+        "cpuCount": os.cpu_count(),
+    }
+    fleet: _FleetProc | None = None
+    t_start = time.monotonic()
+    try:
+        t0 = time.monotonic()
+        engine_json = _serve_setup(env, base, cfg)
+        report["setupSeconds"] = round(time.monotonic() - t0, 1)
+
+        # ---- phase 1: aggregate q/s vs fleet size
+        points: list[dict] = []
+        for r in cfg.throughput_replicas:
+            keep = r == cfg.replicas and r == cfg.throughput_replicas[-1]
+            point, kept = _throughput_point(
+                env, base, engine_json, cfg, r, keep_fleet=keep
+            )
+            points.append(point)
+            if kept is not None:
+                fleet = kept
+        by_r = {p["replicas"]: p for p in points}
+        scaling = None
+        if 1 in by_r and cfg.replicas in by_r and by_r[1]["qps"]:
+            scaling = round(by_r[cfg.replicas]["qps"] / by_r[1]["qps"], 2)
+        report["throughput"] = {
+            "points": points,
+            "scaling": scaling,
+            "note": (
+                "single-core host: replicas time-share one core, so "
+                "aggregate q/s cannot scale with R here — the scaling "
+                "claim applies to the multi-core path (see "
+                "docs/operations.md)"
+            )
+            if (os.cpu_count() or 1) < 2
+            else "multi-core host: q/s should scale with R until cores "
+            "saturate",
+        }
+
+        # ---- phase 2: replica SIGKILL under load
+        if fleet is None:
+            fleet = _FleetProc(env, base, engine_json, cfg.replicas, cfg)
+            fleet.wait_all_ready(cfg.startup_timeout_s)
+        report["kill"] = _kill_phase(fleet, cfg)
+
+        # ---- phase 3: rolling reload under load
+        if cfg.reloads > 0:
+            report["rolling"] = _rolling_phase(fleet, cfg)
+        fleet.stop()
+        fleet = None
+
+        # ---- phase 4: one sharded-replica composition point (R x S)
+        if cfg.sharded_point:
+            # ONE client by design: concurrent sharded queries on the
+            # one-core virtual 8-device mesh starve each other's XLA:CPU
+            # spin-wait collectives into multi-second stalls (measured:
+            # p50 ~10 ms sequential, >20 s tails at concurrency 4), so
+            # any concurrency here measures scheduler collapse, not the
+            # R x S composition this point demonstrates. Real multi-chip
+            # replicas have per-chip threads and no such cliff.
+            point, _ = _throughput_point(
+                env, base, engine_json, cfg,
+                2,  # fixed-size composition point, independent of cfg.replicas
+                extra_args=("--shard-factors",),
+                env_extra={
+                    "XLA_FLAGS": "--xla_force_host_platform_device_count=8"
+                },
+                clients_override=1,
+            )
+            report["shardedReplica"] = point
+        report["totalSeconds"] = round(time.monotonic() - t_start, 1)
+    except (ChaosError, subprocess.TimeoutExpired) as e:
+        report["error"] = str(e)[:800]
+        report["ok"] = False
+        return report
+    finally:
+        if fleet is not None:
+            fleet.stop()
+        if not cfg.keep_dir and cfg.base_dir is None:
+            shutil.rmtree(base, ignore_errors=True)
+        else:
+            report["storageDir"] = base
+    kill = report.get("kill", {})
+    rolling = report.get("rolling", {"failedQueries": 0, "reloadsOk": True,
+                                     "converged": True,
+                                     "crossGenerationViolations": 0})
+    tp = report["throughput"]
+    multi_core = (os.cpu_count() or 1) >= 2
+    report["ok"] = bool(
+        all(p["failed"] == 0 and p["transportErrors"] == 0 for p in tp["points"])
+        and kill.get("killCount", 0) >= cfg.kills
+        and kill.get("failedQueries") == 0
+        and kill.get("allRespawned")
+        and kill.get("p99Recovered")
+        and rolling.get("failedQueries") == 0
+        and rolling.get("reloadsOk")
+        and rolling.get("converged")
+        and rolling.get("crossGenerationViolations") == 0
+        # q/s must scale on a multi-core host; a one-core host documents
+        # the ceiling instead of faking the claim (memory: one-core boxes
+        # wall every throughput-ratio assertion)
+        and (not multi_core or tp["scaling"] is None or tp["scaling"] >= 1.5)
+        and (
+            not cfg.sharded_point
+            or (
+                report.get("shardedReplica", {}).get("failed") == 0
+                and report.get("shardedReplica", {}).get("transportErrors") == 0
+                and report.get("shardedReplica", {}).get("qps", 0) > 0
+            )
+        )
     )
     return report

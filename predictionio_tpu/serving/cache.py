@@ -50,6 +50,7 @@ __all__ = [
     "CacheStats",
     "ResultCache",
     "Singleflight",
+    "affinity_key",
     "canonical_key",
 ]
 
@@ -475,6 +476,22 @@ def extract_scope(body: Any, scope_field: str | None) -> str | None:
     if isinstance(value, (int, float)) and not isinstance(value, bool):
         return str(value)
     return None
+
+
+def affinity_key(body: Any, scope_field: str | None = "user") -> str | None:
+    """Scope→replica routing key of a query body, for the fleet router's
+    consistent hash (``predictionio_tpu.fleet``): the query's
+    invalidation SCOPE when it names one — so all of a scope's queries
+    (and therefore its cached results) land on one replica and the
+    fleet's aggregate cache shards instead of duplicating — else the
+    whole canonical body (repeat identical scope-less queries still
+    stick), else None (route by load). Prefixes keep the two key spaces
+    from colliding with each other."""
+    scope = extract_scope(body, scope_field)
+    if scope is not None:
+        return f"s:{scope}"
+    key = canonical_key(body)
+    return f"q:{key}" if key is not None else None
 
 
 def scopes_from_events(

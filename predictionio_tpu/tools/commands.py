@@ -437,9 +437,70 @@ def status_check(out: Out = _print) -> dict:
         ok = False
     for role, status in results.items():
         out(f"  {role:<10} {status}")
+    fleets = fleet_status(out)
     out("(sanity check) All systems go!" if ok else "Storage check FAILED")
     results["ok"] = ok
+    if fleets:
+        results["fleets"] = fleets
     return results
+
+
+def fleet_status(out: Out = _print) -> list[dict]:
+    """Aggregate every active replica fleet on this host (``pio deploy
+    --replicas``; ISSUE 15): read the supervisor's state files under the
+    deployments dir, probe each replica's ``/readyz``, and report
+    per-replica readiness + model generation plus whether the fleet has
+    converged to ONE generation — the operator's rollout gate."""
+    import glob
+    import urllib.request
+
+    pattern = os.path.join(Storage.base_dir(), "deployments", "fleet-*.json")
+    paths = sorted(glob.glob(pattern))
+    if not paths:
+        return []  # no fleet on this host: never even import the package
+    from predictionio_tpu.fleet.supervisor import read_fleet_state
+
+    fleets: list[dict] = []
+    for path in paths:
+        state = read_fleet_state(path)
+        if state is None:
+            continue
+        replicas = []
+        for rep in state.get("replicas", []):
+            entry = {
+                "id": rep.get("id"),
+                "port": rep.get("port"),
+                "ready": False,
+                "generation": None,
+            }
+            try:
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{rep.get('port')}/readyz", timeout=2
+                ) as resp:
+                    report = json.loads(resp.read())
+            except Exception:
+                report = None
+            if report is not None:
+                entry["ready"] = bool(report.get("ready"))
+                entry["generation"] = report.get("generation")
+            replicas.append(entry)
+        generations = {
+            r["generation"] for r in replicas if r["generation"] is not None
+        }
+        fleet = {
+            "routerPort": state.get("routerPort"),
+            "replicas": replicas,
+            "generationConverged": len(generations) == 1,
+        }
+        fleets.append(fleet)
+        out(
+            f"  fleet      router :{fleet['routerPort']} — "
+            f"{sum(1 for r in replicas if r['ready'])}/{len(replicas)} "
+            f"replicas ready, generations "
+            f"{sorted(generations) if generations else '[]'}"
+            f"{' (converged)' if fleet['generationConverged'] else ''}"
+        )
+    return fleets
 
 
 def _stop_token_path(port: int) -> str:

@@ -153,6 +153,55 @@ def build_parser() -> argparse.ArgumentParser:
     deploy.add_argument("--ip", default="0.0.0.0")
     deploy.add_argument("--port", type=int, default=8000)
     deploy.add_argument("--engine-instance-id")
+    # ---- replica fleet (predictionio_tpu.fleet; docs/operations.md).
+    # Strictly opt-in: without --replicas no fleet module is imported, no
+    # router process exists, and serving is byte-identical (CI-guarded).
+    deploy.add_argument(
+        "--replicas", type=_int_at_least(1), default=0, metavar="N",
+        help="serve through a replica fleet: spawn N query-server "
+        "subprocesses (each composing every other deploy flag, e.g. "
+        "--shard-factors/--quantize/--ann) plus a router on --port that "
+        "load-balances by consistent hash of the cache scope, health-"
+        "gates on /readyz + passive failures + a per-replica circuit "
+        "breaker, fails idempotent requests over to a peer, and "
+        "orchestrates rolling /reload (docs/operations.md fleet runbook)",
+    )
+    deploy.add_argument(
+        "--replica-id", default=None, metavar="ID",
+        help="fleet-internal: this process is replica ID of a fleet "
+        "(set by the supervisor; exposes replicaId/generation on "
+        "/readyz, /stats.json and query response headers)",
+    )
+    deploy.add_argument(
+        "--probe-interval-s", type=float, default=0.25, metavar="S",
+        help="router: seconds between /readyz health probes of each "
+        "replica — a killed or draining replica is routed around within "
+        "one interval (default 0.25)",
+    )
+    deploy.add_argument(
+        "--failover-retries", type=_int_at_least(0), default=1, metavar="N",
+        help="router: most times one idempotent request (GETs and "
+        "/queries.json) is re-dispatched to a peer after a replica "
+        "fails mid-request; non-idempotent routes are never retried "
+        "(default 1)",
+    )
+    deploy.add_argument(
+        "--hedge-ms", type=float, default=0.0, metavar="MS",
+        help="router: hedge a query to a second replica when the first "
+        "has not answered within max(MS, observed p95) — bounds the "
+        "tail one slow replica can impose; 0 (default) disables hedging",
+    )
+    deploy.add_argument(
+        "--fleet-breaker-threshold", type=_int_at_least(1), default=2,
+        metavar="N",
+        help="router: consecutive transport failures that open one "
+        "replica's circuit breaker (default 2)",
+    )
+    deploy.add_argument(
+        "--fleet-breaker-reset-s", type=float, default=1.0, metavar="S",
+        help="router: seconds an open replica breaker waits before "
+        "probing again — the fleet's recovery-time unit (default 1.0)",
+    )
     deploy.add_argument("--feedback", action="store_true")
     deploy.add_argument("--event-server-ip", default="127.0.0.1")
     deploy.add_argument("--event-server-port", type=int, default=7070)
@@ -521,6 +570,50 @@ def build_parser() -> argparse.ArgumentParser:
         help="keep the scratch storage directory for inspection",
     )
 
+    # ---- chaos-serve (predictionio_tpu.resilience.chaos; ISSUE 15)
+    cs = sub.add_parser(
+        "chaos-serve",
+        help="serving-fleet drill: train a tiny model, deploy "
+        "`--replicas N` behind the router, SIGKILL replicas under >= 16 "
+        "concurrent query clients and rolling-/reload the fleet — "
+        "verifying ZERO failed queries, zero cross-generation results, "
+        "and p99 recovery within one breaker reset",
+    )
+    cs.add_argument(
+        "--replicas", type=_int_at_least(1), default=2,
+        help="fleet size for the kill/rolling phases (default 2)",
+    )
+    cs.add_argument(
+        "--clients", type=_int_at_least(1), default=16,
+        help="concurrent query clients (default 16)",
+    )
+    cs.add_argument(
+        "--kills", type=_int_at_least(1), default=1,
+        help="replica SIGKILLs during the kill phase (default 1)",
+    )
+    cs.add_argument(
+        "--seconds", type=float, default=6.0,
+        help="kill-phase duration in seconds (default 6)",
+    )
+    cs.add_argument(
+        "--reloads", type=_int_at_least(0), default=1,
+        help="rolling /reload rotations under load (default 1)",
+    )
+    cs.add_argument(
+        "--events", type=int, default=400,
+        help="synthetic training events (default 400)",
+    )
+    cs.add_argument("--seed", type=int, default=0, help="workload RNG seed")
+    cs.add_argument(
+        "--sharded-point", action="store_true",
+        help="also measure one fleet whose replicas serve with "
+        "--shard-factors (8-way virtual host mesh)",
+    )
+    cs.add_argument(
+        "--keep", action="store_true",
+        help="keep the scratch storage directory for inspection",
+    )
+
     # ---- batchpredict
     bp = sub.add_parser("batchpredict", help="bulk predictions from a query file")
     bp.add_argument("--engine-json", default="engine.json")
@@ -687,6 +780,139 @@ def _setup_compilation_cache() -> None:
         os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1.0")
 
 
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _replica_argv(args, port: int, replica_id: str) -> list[str]:
+    """Reconstruct a single-replica ``deploy`` argv from the parsed fleet
+    args: every non-default deploy flag is carried over (so
+    ``--shard-factors``/``--quantize``/``--ann``/... compose per
+    replica), while the fleet/router flags, the public bind, and TLS are
+    stripped — replicas listen plaintext on loopback (the router
+    terminates TLS) on their own port with their own identity. Derived
+    from the parsed namespace, not raw argv, so ``--flag=value`` spellings
+    and future flags need no special-casing."""
+    defaults = build_parser().parse_args(["deploy"])
+    skip = {
+        "command",
+        # fleet/router-only flags never reach a replica
+        "replicas", "replica_id", "probe_interval_s", "failover_retries",
+        "hedge_ms", "fleet_breaker_threshold", "fleet_breaker_reset_s",
+        # rebound below / router-terminated
+        "ip", "port", "cert", "key",
+    }
+    argv = ["-m", "predictionio_tpu.tools.console", "deploy"]
+    for name, value in sorted(vars(args).items()):
+        if name in skip or value == getattr(defaults, name, None):
+            continue
+        if value is None or value is False:
+            continue
+        flag = "--" + name.replace("_", "-")
+        if value is True:
+            argv.append(flag)
+        else:
+            argv.extend([flag, str(value)])
+    argv.extend(
+        ["--ip", "127.0.0.1", "--port", str(port), "--replica-id", replica_id]
+    )
+    return argv
+
+
+def _deploy_fleet(args) -> int:
+    """``pio deploy --replicas N``: spawn N replica subprocesses under
+    the self-healing supervisor and serve the fleet router on the public
+    port. SIGTERM/SIGINT, ``GET /stop`` (token-gated) and ``pio
+    undeploy`` all stop the WHOLE fleet — replicas must never outlive
+    their router."""
+    import atexit
+    import signal as _signal
+    import threading
+
+    from predictionio_tpu.api.http import serve
+    from predictionio_tpu.data.storage import Storage
+    from predictionio_tpu.fleet import (
+        FleetSupervisor,
+        ModelRegistry,
+        ReplicaSpec,
+        RouterConfig,
+        RouterService,
+        fleet_state_path,
+    )
+    from predictionio_tpu.tools import commands
+
+    base_dir = Storage.base_dir()
+    specs: list[ReplicaSpec] = []
+    endpoints: list[tuple[str, str, int]] = []
+    for i in range(args.replicas):
+        rid = f"r{i}"
+        port = _free_port()
+        specs.append(ReplicaSpec(rid, port, tuple(_replica_argv(args, port, rid))))
+        endpoints.append((rid, "127.0.0.1", port))
+    config = RouterConfig(
+        probe_interval_s=args.probe_interval_s,
+        failover_retries=args.failover_retries,
+        hedge_ms=args.hedge_ms,
+        breaker_threshold=args.fleet_breaker_threshold,
+        breaker_reset_s=args.fleet_breaker_reset_s,
+        scope_field=(
+            None
+            if args.cache_scope_field.lower() in ("none", "")
+            else args.cache_scope_field
+        ),
+    )
+    registry = ModelRegistry(os.path.join(base_dir, "fleet"))
+    router = RouterService(endpoints, config, registry=registry)
+    supervisor = FleetSupervisor(
+        specs, fleet_state_path(base_dir, args.port), args.port
+    )
+    supervisor.start()
+    router.start()
+    stopped = threading.Event()
+
+    def shutdown_fleet():
+        if stopped.is_set():
+            return
+        stopped.set()
+        router.close()
+        supervisor.stop()
+
+    atexit.register(shutdown_fleet)
+
+    def wire_stop(server):
+        router.stop_token = commands.write_stop_token(args.port)
+
+        def stop_all():
+            def run():
+                shutdown_fleet()
+                server.shutdown()
+
+            threading.Thread(target=run, daemon=True).start()
+
+        router.stop_server = stop_all
+        # first signal stops the fleet (replicas get SIGTERM, so each
+        # drains per its own --drain-deadline-s); the router's listener
+        # follows once children are down
+        _signal.signal(_signal.SIGTERM, lambda s, f: stop_all())
+        _signal.signal(_signal.SIGINT, lambda s, f: stop_all())
+
+    print(
+        f"Fleet is deployed: router on {args.ip}:{args.port}, "
+        f"{args.replicas} replica(s) on "
+        f"{', '.join(str(p) for _, _, p in endpoints)}"
+    )
+    serve(
+        router.dispatch, args.ip, args.port,
+        ssl_context=_ssl_from_args(args), ready_callback=wire_stop,
+    )
+    shutdown_fleet()
+    return 0
+
+
 def _lifecycle_from_args(args):
     """Opt-in :class:`~predictionio_tpu.api.lifecycle.DrainManager` from
     ``--drain-deadline-s``. 0 (the default) returns None — signals keep
@@ -802,6 +1028,11 @@ def main(argv: list[str] | None = None) -> int:
             )
             print(f"Training completed. Engine instance: {instance.id}")
         elif cmd == "deploy":
+            if args.replicas and args.replicas > 0:
+                # replica-fleet path (ISSUE 15): router + N replica
+                # subprocesses. Gated here so a fleet-less deploy never
+                # imports predictionio_tpu.fleet (CI-guarded).
+                return _deploy_fleet(args)
             from predictionio_tpu import resilience
             from predictionio_tpu.api.http import serve
             from predictionio_tpu.serving import BatcherConfig
@@ -904,6 +1135,7 @@ def main(argv: list[str] | None = None) -> int:
             service = QueryService(
                 variant, feedback=feedback, instance_id=args.engine_instance_id,
                 batching=batching, cache=cache, ann=ann, online=online,
+                replica_id=args.replica_id,
             )
 
             def wire_stop(server):
@@ -1238,6 +1470,30 @@ def main(argv: list[str] | None = None) -> int:
                     seed=args.seed,
                     bulk_events=args.bulk_events,
                     drain_deadline_s=args.drain_deadline_s,
+                    keep_dir=args.keep,
+                )
+            )
+            print(json.dumps(report, indent=2))
+            return 0 if report["ok"] else 1
+        elif cmd == "chaos-serve":
+            # serving-fleet robustness drill (ISSUE 15): SIGKILL replicas
+            # under concurrent clients, rolling /reload, zero failed
+            # queries (docs/operations.md "Fleet runbook")
+            from predictionio_tpu.resilience.chaos import (
+                ServeChaosConfig,
+                run_chaos_serve,
+            )
+
+            report = run_chaos_serve(
+                ServeChaosConfig(
+                    replicas=args.replicas,
+                    clients=args.clients,
+                    kills=args.kills,
+                    phase_seconds=args.seconds,
+                    reloads=args.reloads,
+                    train_events=args.events,
+                    seed=args.seed,
+                    sharded_point=args.sharded_point,
                     keep_dir=args.keep,
                 )
             )

@@ -131,8 +131,16 @@ class QueryService:
         cache: CacheConfig | None = None,
         ann: AnnConfig | None = None,
         online=None,
+        replica_id: str | None = None,
     ):
         self.variant = variant
+        #: fleet identity (``pio deploy --replica-id``, set by the fleet
+        #: supervisor): reported on /readyz, /stats.json and — so the
+        #: router can tag routed cache keys with the serving generation —
+        #: as X-PIO-Replica / X-PIO-Generation headers on query
+        #: responses. None (the default, every non-fleet deploy) adds no
+        #: headers and leaves responses byte-identical.
+        self.replica_id = replica_id
         self.ctx = ctx or local_context()
         self.plugins = list(plugins)
         self.feedback = feedback
@@ -818,11 +826,21 @@ class QueryService:
                 self.feedback_dropped += 1
             logger.warning("Feedback queue full; dropping prediction event")
 
+    @property
+    def model_generation(self) -> int:
+        """Monotonic per-process reload counter (1 after the first load).
+        The fleet router gates rolling swaps on every replica converging
+        to one value of this."""
+        with self._lock:
+            return self._model_generation
+
     # -------------------------------------------------------------- status
     def status_json(self) -> dict:
         inst = self.instance
         return {
             "status": "alive",
+            "replicaId": self.replica_id,
+            "generation": self.model_generation,
             "engineId": self.variant.id,
             "engineVersion": self.variant.version,
             "engineFactory": self.variant.engine_factory,
@@ -877,8 +895,14 @@ class QueryService:
                 "dropped": self.feedback_dropped,
             }
             degraded = self.degraded
+            generation = self._model_generation
         out: dict = {
             "queryCount": count,
+            # fleet identity + model generation (ISSUE 15): the router
+            # and `pio status` gate rollouts on the fleet converging to
+            # one generation; replicaId is null outside --replicas
+            "replicaId": self.replica_id,
+            "generation": generation,
             "startTime": self.start_time.isoformat(),
             "batching": self.batcher is not None,
             "degraded": degraded,
@@ -950,6 +974,7 @@ class QueryService:
         with self._lock:
             model_ok = self._serving is not None
             degraded = self.degraded
+            generation = self._model_generation
         batcher_ok = self.batcher is None or self.batcher.dispatcher_alive()
         report = readiness_report(
             storage=storage_check(),
@@ -957,6 +982,10 @@ class QueryService:
             batcher={"ok": batcher_ok},
         )
         report["degraded"] = degraded
+        # fleet identity + generation: the router's health probes read
+        # these to gate routing and rolling-swap convergence
+        report["replicaId"] = self.replica_id
+        report["generation"] = generation
         return report
 
     def close(self) -> None:
@@ -990,6 +1019,23 @@ class QueryService:
         from predictionio_tpu.api.service import Response
 
         method = method.upper()
+
+        def tag_replica(resp: "Response") -> "Response":
+            # fleet mode only (--replica-id): stamp which replica and
+            # model generation answered, so the router can enforce the
+            # never-two-generations-per-cache-key contract from served
+            # truth instead of probe staleness. replica_id None (every
+            # non-fleet deploy) returns the response untouched.
+            if self.replica_id is None:
+                return resp
+            tags = {
+                "X-PIO-Replica": self.replica_id,
+                "X-PIO-Generation": str(self.model_generation),
+            }
+            return dataclasses.replace(
+                resp, headers={**(resp.headers or {}), **tags}
+            )
+
         if path == "/" and method == "GET":
             return Response(200, self.status_json())
         if path == "/queries.json" and method == "POST":
@@ -1016,11 +1062,11 @@ class QueryService:
                 # result cache + singleflight in front of the (possibly
                 # batched) scoring path; cache off => the exact branches
                 # below, byte-identical to the pre-cache server
-                return to_response(*self.handle_query_cached(body))
+                return tag_replica(to_response(*self.handle_query_cached(body)))
             if self.batcher is not None:
-                return to_response(*self.batcher.submit(body))
+                return tag_replica(to_response(*self.batcher.submit(body)))
             status, payload = self.handle_query(body)
-            return Response(status, payload)
+            return tag_replica(Response(status, payload))
         if path == "/cache/invalidate.json" and method == "POST":
             # event-driven invalidation hook: {"entityId": "u1"} /
             # {"entityIds": [...]} / {"all": true} / a list of

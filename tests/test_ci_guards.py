@@ -429,6 +429,71 @@ def test_shard_factors_defaults_are_opt_in():
     ), "PIO304 (raw shard_map outside ops/compat.py) fell out of piolint"
 
 
+def test_fleet_defaults_are_opt_in():
+    """ISSUE 15 guard: replica-fleet serving is strictly opt-in. Without
+    ``--replicas`` the deploy parser yields no fleet, no router process
+    exists, nothing under ``predictionio_tpu.fleet`` is ever imported,
+    and a QueryService without a replica_id adds no identity headers —
+    serving stays byte-identical to a fleet-less build. The piolint
+    manifest pins fleet/ stdlib-only (no jax/storage/workflow: replicas
+    are opaque HTTP backends), with only the equally-stdlib resilience,
+    transport, and cache-key helpers allowed."""
+    import inspect
+
+    from predictionio_tpu.tools.console import build_parser
+    from predictionio_tpu.workflow.serving import QueryService
+
+    args = build_parser().parse_args(["deploy"])
+    assert args.replicas == 0  # fleet off
+    assert args.replica_id is None
+    assert args.failover_retries == 1  # one failover, bounded by default
+    assert args.hedge_ms == 0.0  # hedging strictly opt-in
+    sig = inspect.signature(QueryService.__init__)
+    assert sig.parameters["replica_id"].default is None
+    # identity headers gate on replica_id, inside the dispatch source
+    src = inspect.getsource(QueryService.dispatch)
+    assert "if self.replica_id is None" in src
+    # default path never imports the fleet package
+    probe = (
+        "import sys; "
+        "import predictionio_tpu.workflow.serving; "
+        "import predictionio_tpu.tools.console; "
+        "import predictionio_tpu.tools.commands; "
+        "sys.exit(1 if any(m.startswith('predictionio_tpu.fleet') "
+        "for m in sys.modules) else 0)"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", probe], cwd=REPO, capture_output=True
+    )
+    assert proc.returncode == 0, proc.stderr.decode()[-500:]
+    # manifest: fleet/ stdlib-only with the narrow allow-list (chaos-serve
+    # drives the fleet over the wire; the router must never grow a jax or
+    # storage dependency silently)
+    from predictionio_tpu.analysis.manifest import DEFAULT_MANIFEST, find_rule
+
+    fleet = find_rule(DEFAULT_MANIFEST, "predictionio_tpu/fleet")
+    assert fleet is not None and fleet.stdlib_only, (
+        "manifest no longer marks predictionio_tpu/fleet stdlib-only"
+    )
+    assert "predictionio_tpu.resilience" in fleet.allow
+    assert "predictionio_tpu.serving.cache" in fleet.allow
+    assert not any(a.startswith("predictionio_tpu.data") for a in fleet.allow)
+    assert not any(
+        a.startswith("predictionio_tpu.workflow") for a in fleet.allow
+    )
+    # the fleet package imports (with every framework server available)
+    # without jax ever loading — stdlib-only in practice, not just on paper
+    probe = (
+        "import sys; "
+        "import predictionio_tpu.fleet; "
+        "sys.exit(1 if 'jax' in sys.modules else 0)"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", probe], cwd=REPO, capture_output=True
+    )
+    assert proc.returncode == 0, proc.stderr.decode()[-500:]
+
+
 def test_quantize_defaults_are_opt_in(memory_storage_env):
     """ISSUE 13 guard: int8 quantized serving is strictly opt-in.
     Without ``--quantize`` the deploy parser yields no mode, an
@@ -907,6 +972,55 @@ def test_bench_smoke_runs_green():
             f"quantized: {qp}"
         )
         assert qp["sharded"]["queries_per_sec"] > 0
+    # replica-fleet section (ISSUE 15 acceptance): a replica SIGKILL
+    # under >= 16 concurrent clients with ZERO failed queries (every
+    # request answered 2xx by a healthy replica — clients never retry,
+    # the router does), p99 recovered within one breaker-reset interval,
+    # the supervisor respawned the victim, a rolling /reload under load
+    # served zero cross-generation results and converged the fleet to
+    # one generation, and one sharded-replica composition point ran
+    # clean. Aggregate q/s must scale >= 1.5x at R=2 on a multi-core
+    # host; a one-core host documents the ceiling instead (the replicas
+    # time-share one core, so a ratio assertion would measure the
+    # scheduler, not the fleet).
+    fleet = detail.get("serving_fleet")
+    assert fleet is not None, "missing bench section 'serving_fleet'"
+    assert "error" not in fleet, f"serving_fleet errored: {fleet}"
+    assert fleet["clients"] >= 16
+    ftp = fleet["throughput"]
+    assert len(ftp["points"]) >= 2
+    for point in ftp["points"]:
+        assert point["failed"] == 0, f"fleet throughput failed queries: {point}"
+        assert point["transportErrors"] == 0, point
+        assert point["qps"] > 0
+    if (fleet.get("cpuCount") or 1) >= 2:
+        assert ftp["scaling"] is not None and ftp["scaling"] >= 1.5, (
+            f"fleet q/s does not scale on a multi-core host: {ftp}"
+        )
+    else:
+        assert "single-core" in ftp["note"]
+    fkill = fleet["kill"]
+    assert fkill["killCount"] >= 1
+    assert fkill["failedQueries"] == 0, (
+        f"replica SIGKILL leaked failed queries to clients: {fkill}"
+    )
+    assert fkill["allRespawned"] is True, f"supervisor did not heal: {fkill}"
+    assert fkill["p99Recovered"] is True, (
+        f"p99 did not recover within one breaker reset: {fkill}"
+    )
+    frolling = fleet["rolling"]
+    assert frolling["failedQueries"] == 0, (
+        f"rolling reload leaked failed queries: {frolling}"
+    )
+    assert frolling["reloadsOk"] is True and frolling["converged"] is True
+    assert frolling["crossGenerationViolations"] == 0, (
+        f"one cache scope saw two model generations mid-rollout: {frolling}"
+    )
+    assert frolling["routerGenerationRegressions"] == 0
+    fsharded = fleet["shardedReplica"]
+    assert fsharded["failed"] == 0 and fsharded["transportErrors"] == 0
+    assert fsharded["qps"] > 0
+    assert fleet["ok"] is True, f"serving_fleet verdict failed: {fleet}"
     # static-analysis section (ISSUE 3): the bench reports piolint rule
     # and finding counts so the guard output stays machine-checked — a
     # tree with non-baselined findings cannot produce a green smoke

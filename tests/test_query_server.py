@@ -81,6 +81,42 @@ class TestQueryService:
         assert qs.dispatch("POST", "/reload", {}).status == 200
         assert qs.dispatch("GET", "/nope", {}).status == 404
 
+    def test_replica_identity_exposed_in_fleet_mode(self, trained):
+        """ISSUE 15: with a replica_id (set by the fleet supervisor via
+        --replica-id) the service reports its identity + model generation
+        on /readyz and /stats.json, and stamps every query response with
+        X-PIO-Replica / X-PIO-Generation so the router can enforce
+        never-two-generations-per-cache-key from served truth."""
+        _, variant, _ = trained
+        qs = QueryService(variant, replica_id="r7")
+        ready = qs.readiness()
+        assert ready["replicaId"] == "r7"
+        assert ready["generation"] == 1
+        stats = qs.stats_json()
+        assert stats["replicaId"] == "r7"
+        assert stats["generation"] == 1
+        assert qs.status_json()["replicaId"] == "r7"
+        resp = qs.dispatch("POST", "/queries.json", {}, 5)
+        assert resp.status == 200
+        assert resp.headers["X-PIO-Replica"] == "r7"
+        assert resp.headers["X-PIO-Generation"] == "1"
+        # the generation header tracks /reload hot swaps
+        qs.reload()
+        resp = qs.dispatch("POST", "/queries.json", {}, 5)
+        assert resp.headers["X-PIO-Generation"] == "2"
+        assert qs.readiness()["generation"] == 2
+
+    def test_no_replica_headers_outside_fleet_mode(self, trained):
+        """Without --replica-id the query response carries no fleet
+        headers and readiness reports a null replicaId — the non-fleet
+        serving surface stays byte-identical (CI-guarded)."""
+        _, variant, _ = trained
+        qs = QueryService(variant)
+        resp = qs.dispatch("POST", "/queries.json", {}, 5)
+        assert resp.headers is None
+        assert qs.readiness()["replicaId"] is None
+        assert qs.stats_json()["replicaId"] is None
+
     def test_plugins(self, trained):
         _, variant, _ = trained
         seen = []
