@@ -102,6 +102,7 @@ DEFAULT_MANIFEST: Manifest = (
             "predictionio_tpu.serving.cache",
             "predictionio_tpu.api.http",
             "predictionio_tpu.api.lifecycle",
+            "predictionio_tpu.experiments.split",
         ),
         reason="the replica fleet (router, supervisor, registry) is host "
         "orchestration over HTTP: replicas are opaque processes behind "
@@ -153,6 +154,26 @@ DEFAULT_MANIFEST: Manifest = (
         "its whole point, but engine templates, CLI tools, and the "
         "jax-free serving/api packages all sit ABOVE it and import it "
         "lazily — never the reverse",
+    ),
+    PackageRule(
+        package="predictionio_tpu/experiments",
+        forbid=(
+            "predictionio_tpu.templates",
+            "predictionio_tpu.tools",
+            "predictionio_tpu.api",
+        ),
+        reason="experimentation (exploration policies, vmapped sweeps) "
+        "sits on ops+controller+workflow+data and reaches engines only "
+        "through duck-typed folds/payloads — importing a template would "
+        "couple the subsystem to one engine, and the CLI imports "
+        "experiments lazily, never the reverse",
+    ),
+    PackageRule(
+        package="predictionio_tpu/experiments/split.py",
+        stdlib_only=True,
+        reason="A/B traffic splitting runs inside the stdlib-only fleet "
+        "router: assignment is pure hash arithmetic and must import "
+        "nothing — not even the rest of the experiments package",
     ),
     PackageRule(
         package="predictionio_tpu/templates",

@@ -1,0 +1,57 @@
+"""Experimentation subsystem: exploration policies, A/B traffic splits,
+vmapped eval sweeps (ISSUE 16 — the "act" half of the online loop).
+
+Three composing pieces, each importable on its own:
+
+* :mod:`predictionio_tpu.experiments.explore` — jitted epsilon-greedy and
+  Thompson-sampling re-ranking over a deployed engine's top-K scores
+  (``pio deploy --explore <policy>``); rewards fold back through the
+  PR 7 event follower.
+* :mod:`predictionio_tpu.experiments.split` — stdlib-only weighted A/B
+  variant assignment for the fleet router (``pio deploy --replicas N
+  --variants a:2,b:1``): hash-sticky by cache scope, deterministic
+  across router restarts and replica kills, promotable via
+  ``POST /experiments/promote.json``.
+* :mod:`predictionio_tpu.experiments.sweep` — ``pio eval --grid`` trains
+  every grid candidate as ONE vmapped jit (one compile per shape
+  bucket; compile-budget.json carries the ledger entry).
+
+This ``__init__`` is import-light on purpose: the CI guard
+``test_experiments_defaults_are_opt_in`` asserts that a default deploy
+never imports the package, and the fleet router (stdlib-only by
+manifest) imports ``experiments.split`` without ever pulling jax — so
+the submodules load lazily via PEP 562 and nothing heavy runs here.
+"""
+
+from __future__ import annotations
+
+_EXPORTS = {
+    "Variant": ("predictionio_tpu.experiments.split", "Variant"),
+    "SplitConfig": ("predictionio_tpu.experiments.split", "SplitConfig"),
+    "TrafficSplit": ("predictionio_tpu.experiments.split", "TrafficSplit"),
+    "ExploreConfig": ("predictionio_tpu.experiments.explore", "ExploreConfig"),
+    "Explorer": ("predictionio_tpu.experiments.explore", "Explorer"),
+    "run_grid_evaluation": (
+        "predictionio_tpu.experiments.sweep",
+        "run_grid_evaluation",
+    ),
+    "grid_axes": ("predictionio_tpu.experiments.sweep", "grid_axes"),
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name: str):
+    try:
+        module, attr = _EXPORTS[name]
+    except KeyError:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}"
+        ) from None
+    import importlib
+
+    return getattr(importlib.import_module(module), attr)
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_EXPORTS))

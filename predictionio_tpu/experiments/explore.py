@@ -1,0 +1,296 @@
+"""Exploration policies over served top-K scores (ISSUE 16 a).
+
+``pio deploy --explore epsilon|thompson`` re-ranks each query's
+``itemScores`` payload before it leaves the serving path:
+
+* **epsilon-greedy** — with probability ``epsilon`` the served head item
+  is a uniform draw from the candidate list instead of the greedy best;
+  the rest of the list keeps its score order.
+* **thompson** — every candidate's score is perturbed by Gaussian noise
+  whose width is that item's posterior uncertainty, and the list is
+  served in sampled order. The width starts at
+  ``score_spread * prior_scale`` and shrinks as ``1/sqrt(1 + pulls)``
+  with observed impressions — per-row factor-uncertainty shaped, fed by
+  the reward stream (the PR 7 follower hands reward events to
+  :meth:`Explorer.note_reward_events`, or ``POST
+  /experiments/reward.json`` does when online learning is off).
+
+Both kernels are module-level jits over pow2-bucketed candidate arrays
+(floor 8, cap 512): at most ~7 shape buckets per kernel, so the whole
+policy surface stays inside its compile-budget.json entry and the
+jit-witness never sees an unbudgeted retrace on the serving path. The
+PRNG is a fold_in counter over one root key — no per-call key arrays,
+no host randomness, reproducible under a fixed seed.
+
+Regret accounting: every explored query adds ``best_score -
+served_score`` (model-score regret — the measurable proxy; true-reward
+regret is what the bench section measures against a seeded reward
+stream) to a per-policy counter surfaced on ``/stats.json``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import logging
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["ExploreConfig", "Explorer"]
+
+logger = logging.getLogger(__name__)
+
+POLICIES = ("epsilon", "thompson")
+_MIN_BUCKET = 8
+#: beyond this many candidates only the top slice participates in
+#: exploration — the tail of a 10k-item response is never served first
+#: anyway, and the cap bounds the shape-bucket count for the ledger
+_MAX_BUCKET = 512
+
+
+def _bucket(n: int) -> int:
+    return min(_MAX_BUCKET, max(_MIN_BUCKET, 1 << (max(1, n) - 1).bit_length()))
+
+
+@dataclasses.dataclass(frozen=True)
+class ExploreConfig:
+    """``--explore`` flags. Disabled (empty policy) by default — the CI
+    guard asserts a policy-less deploy never imports this module."""
+
+    policy: str = ""
+    epsilon: float = 0.1
+    seed: int = 0
+    #: event name the follower treats as reward signal
+    reward_event: str = "reward"
+    #: Thompson prior width as a fraction of the response's score spread
+    prior_scale: float = 0.25
+
+    def __post_init__(self):
+        if self.policy and self.policy not in POLICIES:
+            raise ValueError(
+                f"--explore must be one of {POLICIES}, got {self.policy!r}"
+            )
+        if not 0.0 <= self.epsilon <= 1.0:
+            raise ValueError(f"--explore-epsilon must be in [0,1], got {self.epsilon}")
+
+    @property
+    def enabled(self) -> bool:
+        return self.policy in POLICIES
+
+
+# --------------------------------------------------------------------- jits
+# Scalars (counter, eps, n_valid) are traced arguments, not static — one
+# compile per shape bucket per kernel, never per value.
+
+
+@jax.jit
+def _thompson_rank(scores, widths, key, counter):
+    """Descending order of posterior samples; -inf padding sorts last."""
+    k = jax.random.fold_in(key, counter)
+    noise = jax.random.normal(k, scores.shape, dtype=scores.dtype)
+    valid = jnp.isfinite(scores)
+    sampled = jnp.where(valid, scores + widths * noise, -jnp.inf)
+    return jnp.argsort(-sampled)
+
+
+@jax.jit
+def _epsilon_rank(scores, key, counter, eps, n_valid):
+    """Greedy order with the (possibly random) head moved to the front.
+
+    Input scores arrive descending (serving order); explore picks a
+    uniform index over the first ``n_valid`` real entries.
+    """
+    k = jax.random.fold_in(key, counter)
+    k1, k2 = jax.random.split(k)
+    explore = jax.random.uniform(k1) < eps
+    valid = jnp.isfinite(scores)
+    best = jnp.argmax(jnp.where(valid, scores, -jnp.inf))
+    rnd = jax.random.randint(k2, (), 0, jnp.maximum(n_valid, 1))
+    chosen = jnp.where(explore, rnd, best)
+    idx = jnp.arange(scores.shape[0])
+    order = jnp.argsort(jnp.where(idx == chosen, -1, idx))
+    return order, explore
+
+
+class _ItemStat:
+    __slots__ = ("pulls", "rewards", "reward_sum")
+
+    def __init__(self):
+        self.pulls = 0
+        self.rewards = 0
+        self.reward_sum = 0.0
+
+
+class Explorer:
+    """Per-service exploration state: one PRNG stream, per-item pull and
+    reward counts (the posterior), policy counters for /stats.json."""
+
+    #: bound on distinct tracked items (catalogs are bounded in practice;
+    #: this is a safety valve, evicting nothing once hit — a never-seen
+    #: item just keeps its prior width)
+    MAX_TRACKED_ITEMS = 200_000
+
+    def __init__(self, config: ExploreConfig):
+        if not config.enabled:
+            raise ValueError("Explorer needs an enabled ExploreConfig")
+        self.config = config
+        self._lock = threading.Lock()
+        self._key = jax.random.PRNGKey(int(config.seed))
+        self._counter = 0
+        self._items: dict[str, _ItemStat] = {}
+        self.queries = 0
+        self.explored = 0
+        self.regret_sum = 0.0
+        self.reward_events = 0
+        self.reward_matched = 0
+        self.reward_value_sum = 0.0
+        self.last_error: str | None = None
+
+    # -------------------------------------------------------------- serving
+    def rerank(self, item_scores: list) -> list:
+        """Re-order a response's ``itemScores`` under the policy.
+
+        Robust by contract: any failure logs once, counts into
+        ``last_error``, and returns the list unchanged — exploration
+        must never fail a query.
+        """
+        try:
+            return self._rerank(item_scores)
+        except Exception as e:  # pragma: no cover - defensive
+            with self._lock:
+                self.last_error = f"{type(e).__name__}: {e}"
+            logger.warning("explore rerank failed; serving greedy: %s", e)
+            return item_scores
+
+    def _rerank(self, item_scores: list) -> list:
+        n = len(item_scores)
+        with self._lock:
+            self.queries += 1
+            if n < 2:
+                return item_scores
+            counter = self._counter
+            self._counter += 1
+            head = item_scores[: min(n, _MAX_BUCKET)]
+            raw = np.array(
+                [float(e.get("score", 0.0)) for e in head], dtype=np.float32
+            )
+            bucket = _bucket(len(head))
+            scores = np.full(bucket, -np.inf, dtype=np.float32)
+            scores[: len(head)] = raw
+            if self.config.policy == "thompson":
+                finite = raw[np.isfinite(raw)]
+                spread = float(finite.max() - finite.min()) if finite.size else 0.0
+                if spread <= 0.0:
+                    spread = 1.0
+                widths = np.zeros(bucket, dtype=np.float32)
+                for i, e in enumerate(head):
+                    st = self._items.get(str(e.get("item")))
+                    pulls = st.pulls if st is not None else 0
+                    widths[i] = (
+                        spread * self.config.prior_scale / (1.0 + pulls) ** 0.5
+                    )
+        if self.config.policy == "thompson":
+            order = np.asarray(
+                _thompson_rank(
+                    jnp.asarray(scores), jnp.asarray(widths), self._key, counter
+                )
+            )
+            explored_flag = None
+        else:
+            order, explored = _epsilon_rank(
+                jnp.asarray(scores),
+                self._key,
+                counter,
+                self.config.epsilon,
+                len(head),
+            )
+            order = np.asarray(order)
+            explored_flag = bool(explored)
+        keep = [int(i) for i in order if i < len(head)]
+        out = [head[i] for i in keep] + item_scores[len(head) :]
+        with self._lock:
+            chosen = keep[0]
+            best = float(raw.max()) if len(head) else 0.0
+            served = float(raw[chosen])
+            if explored_flag is None:
+                explored_flag = chosen != int(raw.argmax())
+            if explored_flag:
+                self.explored += 1
+                self.regret_sum += max(0.0, best - served)
+            item = str(head[chosen].get("item"))
+            st = self._items.get(item)
+            if st is None and len(self._items) < self.MAX_TRACKED_ITEMS:
+                st = self._items[item] = _ItemStat()
+            if st is not None:
+                st.pulls += 1
+        return out
+
+    # -------------------------------------------------------------- rewards
+    def note_reward_events(self, events) -> int:
+        """Fold reward events (storage ``Event`` objects or JSON dicts)
+        into the posterior. Returns how many events matched the
+        configured reward event name. Called from the online follower
+        cycle (PR 7) or the replica's ``POST /experiments/reward.json``.
+        """
+        matched = 0
+        for e in events or ():
+            if isinstance(e, dict):
+                name = e.get("event")
+                item = e.get("targetEntityId") or e.get("item")
+                props = e.get("properties") or {}
+                value = props.get("value", props.get("rating"))
+            else:
+                name = getattr(e, "event", None)
+                item = getattr(e, "target_entity_id", None)
+                props = getattr(e, "properties", None)
+                value = None
+                if props is not None:
+                    value = props.opt("value")
+                    if value is None:
+                        value = props.opt("rating")
+            if name != self.config.reward_event:
+                continue
+            matched += 1
+            try:
+                val = float(value) if value is not None else 1.0
+            except (TypeError, ValueError):
+                val = 1.0
+            with self._lock:
+                self.reward_events += 1
+                self.reward_value_sum += val
+                st = self._items.get(str(item)) if item is not None else None
+                if st is None and item is not None and (
+                    len(self._items) < self.MAX_TRACKED_ITEMS
+                ):
+                    st = self._items[str(item)] = _ItemStat()
+                if st is not None:
+                    self.reward_matched += 1
+                    st.rewards += 1
+                    st.reward_sum += val
+        return matched
+
+    # ---------------------------------------------------------------- stats
+    def stats_json(self) -> dict:
+        with self._lock:
+            return {
+                "policy": self.config.policy,
+                "epsilon": self.config.epsilon,
+                "seed": self.config.seed,
+                "queries": self.queries,
+                "explored": self.explored,
+                "regret": round(self.regret_sum, 6),
+                "regretPerQuery": (
+                    round(self.regret_sum / self.queries, 6) if self.queries else 0.0
+                ),
+                "rewards": {
+                    "events": self.reward_events,
+                    "matched": self.reward_matched,
+                    "valueSum": round(self.reward_value_sum, 6),
+                    "event": self.config.reward_event,
+                },
+                "itemsTracked": len(self._items),
+                "lastError": self.last_error,
+            }

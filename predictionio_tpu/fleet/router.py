@@ -339,9 +339,14 @@ class RouterService:
         replicas: Sequence[tuple[str, str, int]],  # (id, host, port)
         config: RouterConfig | None = None,
         registry: ModelRegistry | None = None,
+        split=None,
     ):
         self.config = config or RouterConfig()
         self.registry = registry
+        #: optional experiments.split.TrafficSplit — A/B assignment is a
+        #: pure function of (salt, weights, affinity key), so stickiness
+        #: survives router restarts and replica failover by construction
+        self.split = split
         self.replicas: list[ReplicaState] = [
             ReplicaState(rid, host, port, self.config)
             for rid, host, port in replicas
@@ -455,6 +460,7 @@ class RouterService:
         body_bytes: bytes | None,
         timeout_s: float | None = None,
         count_load: bool = True,
+        extra_headers: Mapping[str, str] | None = None,
     ) -> tuple[int, bytes, dict]:
         """One HTTP round trip to ``rep``; raises :class:`TransportError`
         on anything below the HTTP layer. Returns
@@ -469,6 +475,8 @@ class RouterService:
         else:
             conn = rep.pool.get()
         headers = {"Content-Type": "application/json"}
+        if extra_headers:
+            headers.update(extra_headers)
         if body_bytes is not None:
             headers["Content-Length"] = str(len(body_bytes))
         if count_load:
@@ -575,10 +583,16 @@ class RouterService:
         return max(self.config.hedge_ms / 1000.0, self._p95_s())
 
     def _forward_query(
-        self, rep: ReplicaState, body_bytes: bytes
+        self,
+        rep: ReplicaState,
+        body_bytes: bytes,
+        extra_headers: Mapping[str, str] | None = None,
     ) -> tuple[int, bytes, dict]:
         t0 = time.monotonic()
-        result = self._forward(rep, "POST", "/queries.json", body_bytes)
+        result = self._forward(
+            rep, "POST", "/queries.json", body_bytes,
+            extra_headers=extra_headers,
+        )
         self._record_latency(time.monotonic() - t0)
         return result
 
@@ -587,6 +601,7 @@ class RouterService:
         rep: ReplicaState,
         backup: ReplicaState | None,
         body_bytes: bytes,
+        extra_headers: Mapping[str, str] | None = None,
     ) -> tuple[ReplicaState, int, bytes, dict]:
         """Primary forward with one optional hedge: first answer wins.
         Raises TransportError only when every launched attempt failed."""
@@ -594,7 +609,9 @@ class RouterService:
 
         def attempt(r: ReplicaState) -> None:
             try:
-                results.put((r, self._forward_query(r, body_bytes)))
+                results.put(
+                    (r, self._forward_query(r, body_bytes, extra_headers))
+                )
             except TransportError as e:
                 r.note_transport_failure(str(e))
                 results.put((r, e))
@@ -648,7 +665,21 @@ class RouterService:
         except (TypeError, ValueError):
             return _Wire(400, {"message": "Query body is required (JSON)."})
         key = affinity_key(body, self.config.scope_field)
-        min_gen = self._key_gen_get(key)
+        variant = self.split.assign(key) if self.split is not None else None
+        # per-variant generation streams: during a promotion rollout two
+        # variants may legitimately serve the same scope from different
+        # generations, so the never-two-generations guard tracks
+        # (variant, key) — variant names cannot contain "|" (validated in
+        # experiments.split), so the tag cannot collide with a raw key
+        gen_key = (
+            f"{variant}|{key}"
+            if variant is not None and key is not None
+            else key
+        )
+        variant_headers = (
+            {"X-PIO-Variant": variant} if variant is not None else None
+        )
+        min_gen = self._key_gen_get(gen_key)
         candidates = self._candidates(key, min_gen)
         if not candidates:
             return self._all_down_response()
@@ -681,14 +712,17 @@ class RouterService:
                     ),
                     None,
                 )
+            t_fwd = time.monotonic()
             try:
                 if hedge_backup is not None:
                     rep, status, raw, rhdrs = self._forward_hedged(
-                        rep, hedge_backup, body_bytes
+                        rep, hedge_backup, body_bytes, variant_headers
                     )
                     tried.add(rep.id)
                 else:
-                    status, raw, rhdrs = self._forward_query(rep, body_bytes)
+                    status, raw, rhdrs = self._forward_query(
+                        rep, body_bytes, variant_headers
+                    )
             except TransportError as e:
                 if hedge_backup is None:
                     # the hedged path already recorded each failed
@@ -700,6 +734,10 @@ class RouterService:
                     failovers += 1
                     self.stats.incr("failovers")
                     continue
+                if variant is not None:
+                    self.split.note_routed(
+                        variant, time.monotonic() - t_fwd, ok=False
+                    )
                 return _Wire(
                     502,
                     {
@@ -737,14 +775,20 @@ class RouterService:
                 # key the newer one already answered — surfaced, counted,
                 # and asserted zero during orderly rollouts
                 self.stats.incr("generation_regressions")
-            self._key_gen_put(key, served_gen)
+            self._key_gen_put(gen_key, served_gen)
             self.stats.incr("routed")
+            if variant is not None:
+                self.split.note_routed(
+                    variant, time.monotonic() - t_fwd, ok=status == 200
+                )
             out_headers = {
                 k.title(): v
                 for k, v in rhdrs.items()
                 if k in _FORWARDED_HEADERS
             }
             out_headers["X-PIO-Routed-Replica"] = rep.id
+            if variant is not None:
+                out_headers["X-PIO-Variant"] = variant
             return _Wire(status, raw=raw, headers=out_headers)
         if last_503 is not None:
             # every peer was also draining/down: the drain 503 (with its
@@ -1011,6 +1055,8 @@ class RouterService:
             "generation": self.generation_converged(),
             "p95Seconds": round(self._p95_s(), 6),
         }
+        if self.split is not None:
+            out["experiments"] = self.split.stats_json()
         if fanout:
             details: dict[str, Any] = {}
             for rep in self.replicas:
@@ -1039,6 +1085,98 @@ class RouterService:
             "generation": self.generation_converged(),
         }
 
+    # ---------------------------------------------------------- experiments
+    def experiments_json(self) -> dict:
+        """``GET /experiments.json``: the live experiment — config,
+        per-variant counters, and the promotion stamp (plus the registry
+        record a promotion published, when one exists)."""
+        out: dict[str, Any] = self.split.stats_json()
+        out["scopeField"] = self.config.scope_field
+        if self.registry is not None:
+            current = self.registry.current()
+            meta = getattr(current, "meta", None) if current else None
+            if isinstance(meta, dict) and meta.get("source") == (
+                "experiment_promotion"
+            ):
+                out["registryPromotion"] = {
+                    "generation": current.generation,
+                    "engineInstanceId": current.engine_instance_id,
+                    "variant": meta.get("variant"),
+                }
+        return out
+
+    def promote_experiment(self, body: Any) -> tuple[int, dict]:
+        """``POST /experiments/promote.json`` ``{"variant": name}``:
+        collapse traffic onto the winner, stamp the outcome into the
+        model registry, and rotate the fleet through a rolling reload so
+        every replica converges on one generation with zero failed
+        queries (PR 15's drain semantics)."""
+        name = (body or {}).get("variant") if isinstance(body, dict) else None
+        if not isinstance(name, str) or not name:
+            return 400, {
+                "message": 'Promotion body must be {"variant": "<name>"}.'
+            }
+        try:
+            promotion = self.split.promote(name)
+        except ValueError as e:
+            return 404, {"message": str(e)}
+        report: dict[str, Any] = {"promotion": promotion}
+        if self.registry is not None and self.replicas:
+            # stamp rollout truth: the instance id the fleet is actually
+            # serving, read from a replica, not deployment intent
+            inst = None
+            for rep in self.replicas:
+                try:
+                    _s, raw, _h = self._forward(rep, "GET", "/", None)
+                    inst = (json.loads(raw) or {}).get("engineInstanceId")
+                except (TransportError, json.JSONDecodeError):
+                    continue
+                if inst:
+                    break
+            if inst:
+                record = self.registry.publish(
+                    inst,
+                    meta={
+                        "source": "experiment_promotion",
+                        "variant": name,
+                        "weightsBefore": promotion.get("weightsBefore"),
+                    },
+                )
+                report["registry"] = {
+                    "generation": record.generation,
+                    "engineInstanceId": inst,
+                }
+        status, reload_report = self.rolling_reload()
+        report["reload"] = reload_report
+        report["ok"] = status == 200
+        return (200 if status == 200 else 500), report
+
+    def reward_experiment(self, body: Any) -> tuple[int, dict]:
+        """``POST /experiments/reward.json``: fold reward observations
+        into the per-variant counters. Each item names its variant
+        explicitly, or carries the original query body's scope fields so
+        the router re-derives the assignment (same pure function that
+        routed it)."""
+        items = body if isinstance(body, list) else [body]
+        matched = 0
+        for item in items:
+            if not isinstance(item, dict):
+                continue
+            variant = item.get("variant")
+            if not isinstance(variant, str) or not variant:
+                key = affinity_key(item, self.config.scope_field)
+                if key is None:
+                    continue
+                variant = self.split.assign(key)
+            value = item.get("value", 1.0)
+            if variant in self.split.variant_names():
+                self.split.note_reward(variant, value)
+                matched += 1
+        return 200, {
+            "matched": matched,
+            "experiments": self.split.stats_json(),
+        }
+
     # ------------------------------------------------------------- dispatch
     def dispatch(
         self,
@@ -1063,6 +1201,22 @@ class RouterService:
             )
         if path == "/reload" and method == "POST":
             status, report = self.rolling_reload()
+            return _Wire(status, report)
+        if path.startswith("/experiments") and self.split is None:
+            return _Wire(
+                404,
+                {
+                    "message": "No experiment is configured on this fleet "
+                    "(deploy with --variants name:weight,...)."
+                },
+            )
+        if path == "/experiments.json" and method == "GET":
+            return _Wire(200, self.experiments_json())
+        if path == "/experiments/promote.json" and method == "POST":
+            status, report = self.promote_experiment(body)
+            return _Wire(status, report)
+        if path == "/experiments/reward.json" and method == "POST":
+            status, report = self.reward_experiment(body)
             return _Wire(status, report)
         if path == "/stop" and method == "GET":
             presented = ""

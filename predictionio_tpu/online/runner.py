@@ -222,6 +222,16 @@ class OnlineRunner:
         events = self.follower.poll()
         if not events:
             return {"events": 0, "applied": False}
+        # exploration reward fold-back (ISSUE 16): the same polled batch
+        # feeds the explorer's posterior — reward events are telemetry
+        # for the bandit, not ratings, so they ride beside the fold
+        # pipeline (which ignores non-rating events) rather than in it
+        explorer = getattr(svc, "explorer", None)
+        if explorer is not None:
+            try:
+                explorer.note_reward_events(events)
+            except Exception:
+                logger.exception("explorer reward fold-back failed")
         deltas = to_deltas(events)
         newest_us = max((d.t_us for d in deltas), default=0)
         applied_any = False

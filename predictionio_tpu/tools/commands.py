@@ -492,6 +492,9 @@ def fleet_status(out: Out = _print) -> list[dict]:
             "replicas": replicas,
             "generationConverged": len(generations) == 1,
         }
+        experiment = _fleet_experiment(state.get("routerPort"))
+        if experiment is not None:
+            fleet["experiment"] = experiment
         fleets.append(fleet)
         out(
             f"  fleet      router :{fleet['routerPort']} — "
@@ -500,7 +503,61 @@ def fleet_status(out: Out = _print) -> list[dict]:
             f"{sorted(generations) if generations else '[]'}"
             f"{' (converged)' if fleet['generationConverged'] else ''}"
         )
+        if experiment is not None:
+            arms = ", ".join(
+                f"{v['name']}:{v['weight']:g} "
+                f"({v['routed']} routed, {v['rewardCount']} rewards)"
+                for v in experiment.get("variants", [])
+            )
+            promoted = experiment.get("promoted")
+            out(
+                "  experiment "
+                + (arms or "(no variants)")
+                + (
+                    f" — PROMOTED {promoted['variant']} at {promoted['at']}"
+                    if promoted
+                    else ""
+                )
+            )
     return fleets
+
+
+def _fleet_experiment(router_port) -> dict | None:
+    """One fleet's active experiment (``pio status``; ISSUE 16): the
+    router's live ``/experiments.json`` (variants, weights, sample
+    counts, promotion stamp), falling back to the registry file's
+    promotion record when the router is down. None = no experiment."""
+    import urllib.request
+
+    if router_port:
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{router_port}/experiments.json", timeout=2
+            ) as resp:
+                if resp.status == 200:
+                    return json.loads(resp.read())
+        except Exception:
+            pass
+    # router unreachable (or answered non-200): the promotion stamp in
+    # the fleet registry is still on disk
+    registry_path = os.path.join(
+        Storage.base_dir(), "fleet", "model-registry.json"
+    )
+    try:
+        with open(registry_path) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    meta = ((doc.get("current") or {}).get("meta")) or {}
+    if meta.get("source") != "experiment_promotion":
+        return None
+    return {
+        "variants": [],
+        "promoted": {
+            "variant": meta.get("variant"),
+            "at": (doc.get("current") or {}).get("publishedAt"),
+        },
+    }
 
 
 def _stop_token_path(port: int) -> str:

@@ -374,6 +374,45 @@ def build_parser() -> argparse.ArgumentParser:
         help="fold events already in the store at deploy time too "
         "(default: start at the end of the stream)",
     )
+    # ---- experimentation (predictionio_tpu.experiments; docs/serving.md).
+    # Strictly opt-in: without --explore/--variants the package is never
+    # imported and serving is byte-identical (CI-guarded).
+    deploy.add_argument(
+        "--explore", choices=("epsilon", "thompson"), default=None,
+        metavar="POLICY",
+        help="rerank each query's top-K through a bandit exploration "
+        "policy (epsilon-greedy or Thompson sampling over per-item "
+        "posteriors); reward events fold back through --online's "
+        "follower or POST /experiments/reward.json, and /stats.json "
+        "grows an 'explore' section with the cumulative regret counter "
+        "(docs/serving.md)",
+    )
+    deploy.add_argument(
+        "--explore-epsilon", type=float, default=0.1, metavar="E",
+        help="epsilon policy: probability a query serves an exploration "
+        "slate instead of the exploit ranking (default 0.1)",
+    )
+    deploy.add_argument(
+        "--explore-seed", type=int, default=0,
+        help="PRNG seed of the exploration policy (per-query keys are "
+        "folded from a served-query counter; default 0)",
+    )
+    deploy.add_argument(
+        "--explore-reward-event", default="reward", metavar="NAME",
+        help="event name counted as bandit reward when folding the event "
+        "tail back into the policy posterior (default 'reward')",
+    )
+    deploy.add_argument(
+        "--variants", default="", metavar="NAME[:W],NAME[:W],...",
+        help="router-only (requires --replicas): split /queries.json "
+        "traffic into weighted A/B variants sticky by cache scope — "
+        "assignment is a pure hash of (salt, weights, scope), so it "
+        "survives router restarts and replica failover; per-variant "
+        "q/s, p50/p99 and reward counters appear on the router's "
+        "/stats.json, and POST /experiments/promote.json collapses "
+        "traffic onto the winner and rolls it fleet-wide "
+        "(docs/operations.md experiment runbook)",
+    )
     # ---- resilience (predictionio_tpu.resilience; docs/operations.md).
     # Defaults are the do-nothing configuration: single-attempt storage
     # calls, no breaker — identical to a build without these flags.
@@ -462,6 +501,14 @@ def build_parser() -> argparse.ArgumentParser:
     )
     ev.add_argument("--batch", default="")
     ev.add_argument("--output-path", default="best.json")
+    ev.add_argument(
+        "--grid", action="store_true",
+        help="train and score every candidate in ONE vmapped jit per "
+        "fold shape (one compile per sweep, not per candidate) when the "
+        "generator sweeps numeric ALS axes (lambda/alpha/seed); any "
+        "non-vmappable sweep falls back to the sequential evaluator "
+        "with the same output contract (docs/evaluation.md)",
+    )
 
     # ---- eventserver
     es = sub.add_parser("eventserver", help="start the event server")
@@ -803,6 +850,7 @@ def _replica_argv(args, port: int, replica_id: str) -> list[str]:
         # fleet/router-only flags never reach a replica
         "replicas", "replica_id", "probe_interval_s", "failover_retries",
         "hedge_ms", "fleet_breaker_threshold", "fleet_breaker_reset_s",
+        "variants",
         # rebound below / router-terminated
         "ip", "port", "cert", "key",
     }
@@ -866,7 +914,20 @@ def _deploy_fleet(args) -> int:
         ),
     )
     registry = ModelRegistry(os.path.join(base_dir, "fleet"))
-    router = RouterService(endpoints, config, registry=registry)
+    split = None
+    if args.variants:
+        # lazy: without --variants no experiments module is imported
+        from predictionio_tpu.experiments.split import SplitConfig, TrafficSplit
+
+        split = TrafficSplit(SplitConfig.parse(args.variants))
+        print(
+            "A/B experiment: "
+            + ", ".join(
+                f"{v.name}:{v.weight:g}" for v in split.config.variants
+            )
+            + f" (sticky by {config.scope_field or 'whole-body hash'})"
+        )
+    router = RouterService(endpoints, config, registry=registry, split=split)
     supervisor = FleetSupervisor(
         specs, fleet_state_path(base_dir, args.port), args.port
     )
@@ -1132,10 +1193,21 @@ def main(argv: list[str] | None = None) -> int:
                     prior_weight=args.online_prior_weight,
                     from_start=args.online_from_start,
                 )
+            explore = None
+            if args.explore:
+                # lazy: without --explore no experiments module is imported
+                from predictionio_tpu.experiments.explore import ExploreConfig
+
+                explore = ExploreConfig(
+                    policy=args.explore,
+                    epsilon=args.explore_epsilon,
+                    seed=args.explore_seed,
+                    reward_event=args.explore_reward_event,
+                )
             service = QueryService(
                 variant, feedback=feedback, instance_id=args.engine_instance_id,
                 batching=batching, cache=cache, ann=ann, online=online,
-                replica_id=args.replica_id,
+                explore=explore, replica_id=args.replica_id,
             )
 
             def wire_stop(server):
@@ -1178,14 +1250,29 @@ def main(argv: list[str] | None = None) -> int:
                 generator = EngineParamsGenerator(
                     getattr(evaluation, "engine_params_list", ())
                 )
-            instance, result = run_evaluation(
-                evaluation,
-                generator,
-                local_context(),
-                WorkflowParams(batch=args.batch),
-                evaluation_class=args.evaluation,
-                generator_class=args.params_generator or "",
-            )
+            if args.grid:
+                # lazy: without --grid no experiments module is imported
+                from predictionio_tpu.experiments.sweep import (
+                    run_grid_evaluation,
+                )
+
+                instance, result = run_grid_evaluation(
+                    evaluation,
+                    generator,
+                    local_context(),
+                    WorkflowParams(batch=args.batch),
+                    evaluation_class=args.evaluation,
+                    generator_class=args.params_generator or "",
+                )
+            else:
+                instance, result = run_evaluation(
+                    evaluation,
+                    generator,
+                    local_context(),
+                    WorkflowParams(batch=args.batch),
+                    evaluation_class=args.evaluation,
+                    generator_class=args.params_generator or "",
+                )
             print(result.leaderboard())
             with open(args.output_path, "w") as f:
                 json.dump(result.to_json(), f, indent=2, default=str)

@@ -131,6 +131,7 @@ class QueryService:
         cache: CacheConfig | None = None,
         ann: AnnConfig | None = None,
         online=None,
+        explore=None,
         replica_id: str | None = None,
     ):
         self.variant = variant
@@ -164,6 +165,26 @@ class QueryService:
         )
         if quantize_mode:
             self._cache_mode = f"{self._cache_mode}+q{quantize_mode}"
+        # exploration policies (pio deploy --explore; docs/serving.md).
+        # Strictly opt-in: explore=None (or a disabled config) leaves
+        # every response byte-identical and never imports
+        # predictionio_tpu.experiments (CI-guarded like online/fleet).
+        # The policy joins the cache-mode tag so an exploring
+        # deployment's re-ranked results never serve a greedy
+        # deployment's cache entries or vice versa.
+        self.explore_config = (
+            explore if explore is not None and explore.enabled else None
+        )
+        #: live Explorer (None unless --explore): public so the online
+        #: runner can feed polled reward events back into the posterior
+        self.explorer = None
+        if self.explore_config is not None:
+            from predictionio_tpu.experiments.explore import Explorer
+
+            self.explorer = Explorer(self.explore_config)
+            self._cache_mode = (
+                f"{self._cache_mode}+x{self.explore_config.policy}"
+            )
         #: AnnRuntime per ANN-built model of the LIVE generation
         #: (swapped with the pairs under the lock on every reload)
         self._ann_runtimes: list = []
@@ -474,7 +495,7 @@ class QueryService:
             return body
         return params_from_json(query_class, body)
 
-    def handle_query(self, body: Any) -> tuple[int, Any]:
+    def handle_query(self, body: Any, variant: str | None = None) -> tuple[int, Any]:
         # snapshot under the lock so an in-flight query is internally
         # consistent across a concurrent /reload hot-swap
         with self._lock:
@@ -490,13 +511,18 @@ class QueryService:
             return 400, {"message": f"Invalid query: {e}"}
         query = serving.supplement_base(query)
         predictions = [algo.predict_base(model, query) for algo, model in pairs]
-        return self._finish_query(serving, body, query, predictions)
+        return self._finish_query(serving, body, query, predictions, variant)
 
     def _finish_query(
-        self, serving, body: Any, query: Any, predictions: Sequence[Any]
+        self,
+        serving,
+        body: Any,
+        query: Any,
+        predictions: Sequence[Any],
+        variant: str | None = None,
     ) -> tuple[int, Any]:
-        """serve -> plugins -> feedback -> count, shared by the single and
-        batch routes so they cannot diverge."""
+        """serve -> explore -> plugins -> feedback -> count, shared by the
+        single and batch routes so they cannot diverge."""
         result = serving.serve_base(query, predictions)
         payload = _result_to_json(result)
         pr_id = None
@@ -504,26 +530,41 @@ class QueryService:
             pr_id = uuid.uuid4().hex
             if isinstance(payload, dict):
                 payload = dict(payload, prId=pr_id)
+        if self.explorer is not None and isinstance(payload, dict):
+            # policy re-rank between scoring and the plugins: plugins and
+            # feedback must see the order actually served
+            items = payload.get("itemScores")
+            if isinstance(items, list) and items:
+                payload = dict(payload, itemScores=self.explorer.rerank(items))
         for plugin in self.plugins:
             if plugin.plugin_type == "outputblocker":
                 payload = plugin.process(query, payload, self)
             else:
                 plugin.process(query, payload, self)
         if self.feedback is not None:
-            self._send_feedback(body, payload, pr_id)
+            self._send_feedback(body, payload, pr_id, variant)
         with self._lock:
             self.query_count += 1
         return 200, payload
 
     # ------------------------------------------------------- cached queries
-    def _scored_query(self, body: Any) -> tuple[int, Any]:
+    def _scored_query(
+        self, body: Any, variant: str | None = None
+    ) -> tuple[int, Any]:
         """The uncached scoring path — through the micro-batcher when one
-        is configured, else the per-request path."""
+        is configured, else the per-request path. The micro-batched path
+        drops the per-request variant tag (a batch mixes variants; its
+        feedback events carry no variant field — documented limitation,
+        docs/serving.md)."""
         if self.batcher is not None:
             return self.batcher.submit(body)
-        return self.handle_query(body)
+        if variant is None:
+            return self.handle_query(body)
+        return self.handle_query(body, variant)
 
-    def handle_query_cached(self, body: Any) -> tuple[int, Any]:
+    def handle_query_cached(
+        self, body: Any, variant: str | None = None
+    ) -> tuple[int, Any]:
         """/queries.json with the cache tiers applied (docs/serving.md):
 
         1. result-LRU lookup (generation-validated, TTL-bounded);
@@ -539,16 +580,23 @@ class QueryService:
         they do coalesce — N identical failing queries in flight pay one
         computation."""
         if self._result_cache is None and self._singleflight is None:
-            return self._scored_query(body)  # pin-model-only config
+            return self._scored_query(body, variant)  # pin-model-only config
         key = canonical_key(body)
         if key is None:
             self._cache_stats.incr("uncacheable")
-            return self._scored_query(body)
+            return self._scored_query(body, variant)
         # retrieval mode is part of the key: an ANN answer is a
         # different (approximate) result for the same body, so exact and
         # ANN entries must never serve each other — not across a config
         # change, and not between deployments sharing a warmed cache
         key = f"{self._cache_mode}|{key}"
+        if variant is not None:
+            # A/B experiments (ISSUE 16): the router's X-PIO-Variant tag
+            # namespaces the result cache AND the singleflight (the
+            # flight key embeds this key) so two variants never serve
+            # each other's entries — variant names cannot contain the
+            # "|" separator (validated by experiments.split)
+            key = f"v={variant}|{key}"
         cfg = self.cache_config
         rc = self._result_cache
         scope = extract_scope(body, cfg.scope_field)
@@ -559,7 +607,7 @@ class QueryService:
 
         def compute() -> tuple[int, Any]:
             token = rc.reserve(key, scope) if rc is not None else None
-            result = self._scored_query(body)
+            result = self._scored_query(body, variant)
             if rc is not None and result[0] == 200:
                 rc.commit(token, result)
             return result
@@ -791,11 +839,27 @@ class QueryService:
         return lines
 
     # ------------------------------------------------------------ feedback
-    def _send_feedback(self, query_body: Any, payload: Any, pr_id: str | None) -> None:
+    def _send_feedback(
+        self,
+        query_body: Any,
+        payload: Any,
+        pr_id: str | None,
+        variant: str | None = None,
+    ) -> None:
         """Async POST of the prediction as a ``predict`` event
         (parity: the feedback loop in CreateServer)."""
         fb = self.feedback
         assert fb is not None
+        properties: dict = {"query": query_body, "prediction": payload}
+        # experiment attribution (ISSUE 16): the active A/B variant and
+        # exploration policy ride in properties so reward joins are
+        # exact. The eventId stays pio_fb_<prId> — a retried POST is
+        # still the same event to the store's dedup, stamped or not.
+        if variant is not None:
+            properties["variant"] = variant
+        explore_config = getattr(self, "explore_config", None)
+        if explore_config is not None:
+            properties["policy"] = explore_config.policy
         event = {
             # deterministic client eventId derived from the prediction id:
             # the worker's POST becomes retry-safe under the event store's
@@ -805,7 +869,7 @@ class QueryService:
             "event": "predict",
             "entityType": "pio_pr",
             "entityId": pr_id or "",
-            "properties": {"query": query_body, "prediction": payload},
+            "properties": properties,
             "prId": pr_id,
             "eventTime": _dt.datetime.now(_dt.timezone.utc).isoformat(),
         }
@@ -869,6 +933,11 @@ class QueryService:
             ),
             "ann": self.ann_config is not None,
             "online": self.online is not None,
+            "explore": (
+                self.explore_config.policy
+                if self.explore_config is not None
+                else None
+            ),
             # degraded-mode semantics (docs/operations.md): serving the
             # last-good model after a failed reload
             "degraded": self.degraded,
@@ -918,6 +987,11 @@ class QueryService:
             # hit/miss/coalesced counters, eviction + invalidation
             # breakdown, bytes pinned (docs/performance.md)
             out["cache"] = self._cache_stats.to_json()
+        if self.explorer is not None:
+            # per-policy exploration decomposition (docs/serving.md):
+            # queries/explored counts, cumulative model-score regret,
+            # reward-event posterior feed
+            out["explore"] = self.explorer.stats_json()
         if self.online is not None:
             # freshness decomposition (docs/operations.md): events
             # folded, fold latency, watermark lag, and the measured
@@ -1058,14 +1132,35 @@ class QueryService:
                     )
                 return Response(status, payload)
 
+            # A/B experiments (ISSUE 16): the fleet router tags routed
+            # queries with the assigned variant; the tag namespaces the
+            # cache/singleflight keys and stamps feedback events. Absent
+            # header (every non-experiment deploy) => variant None and
+            # the exact prior code paths.
+            variant_tag = None
+            if headers:
+                variant_tag = next(
+                    (
+                        v
+                        for k, v in headers.items()
+                        if k.lower() == "x-pio-variant"
+                    ),
+                    None,
+                ) or None
             if self.cache_config is not None:
                 # result cache + singleflight in front of the (possibly
                 # batched) scoring path; cache off => the exact branches
                 # below, byte-identical to the pre-cache server
-                return tag_replica(to_response(*self.handle_query_cached(body)))
+                return tag_replica(
+                    to_response(*self.handle_query_cached(body, variant_tag))
+                )
             if self.batcher is not None:
                 return tag_replica(to_response(*self.batcher.submit(body)))
-            status, payload = self.handle_query(body)
+            status, payload = (
+                self.handle_query(body)
+                if variant_tag is None
+                else self.handle_query(body, variant_tag)
+            )
             return tag_replica(Response(status, payload))
         if path == "/cache/invalidate.json" and method == "POST":
             # event-driven invalidation hook: {"entityId": "u1"} /
@@ -1107,6 +1202,26 @@ class QueryService:
                 return Response(200, self.online.fold_now())
             except Exception as e:
                 return Response(500, {"message": str(e)[:300]})
+        if path == "/experiments/reward.json" and method == "POST":
+            # reward entry point for the explorer's posterior when online
+            # learning is off (with --online the PR 7 follower feeds
+            # reward events automatically); body is one event dict or a
+            # list of them, event-server shaped
+            if self.explorer is None:
+                return Response(
+                    404,
+                    {"message": "Exploration is off on this deployment "
+                                "(enable with pio deploy --explore)."},
+                )
+            events = (
+                body
+                if isinstance(body, list)
+                else [body] if isinstance(body, Mapping) else []
+            )
+            matched = self.explorer.note_reward_events(events)
+            return Response(
+                200, {"matched": matched, "explore": self.explorer.stats_json()}
+            )
         if path == "/reload" and method == "POST":
             try:
                 self.reload()

@@ -1,0 +1,440 @@
+"""Exploration-policy and vmapped-sweep tests (ISSUE 16 tentpole a/c).
+
+Exploration: both policies must be deterministic under a fixed seed,
+must never fail a query (malformed payloads serve greedy), and the
+regret counter must track exactly the explored queries. Sweep: the
+vmap-compatibility detector must accept only grids one program can
+train, the kernel must rank candidates sensibly (a crushing regularizer
+loses), and ``pio eval --grid`` must keep ``run_evaluation``'s
+EvaluationInstance contract on both the vmapped and the fallback path.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.experiments.explore import ExploreConfig, Explorer
+from predictionio_tpu.experiments.sweep import (
+    GridAxes,
+    fold_arrays,
+    grid_axes,
+    grid_train_eval,
+    run_grid_evaluation,
+)
+
+
+def _scores(n: int, start: float = 10.0):
+    return [
+        {"item": f"i{j}", "score": start - j} for j in range(n)
+    ]
+
+
+# ------------------------------------------------------------ exploration
+class TestExploreConfig:
+    def test_rejects_unknown_policy(self):
+        with pytest.raises(ValueError, match="--explore"):
+            ExploreConfig(policy="ucb")
+
+    def test_rejects_bad_epsilon(self):
+        with pytest.raises(ValueError, match="epsilon"):
+            ExploreConfig(policy="epsilon", epsilon=1.5)
+
+    def test_disabled_by_default(self):
+        assert not ExploreConfig().enabled
+        assert ExploreConfig(policy="thompson").enabled
+        with pytest.raises(ValueError):
+            Explorer(ExploreConfig())
+
+
+class TestEpsilonGreedy:
+    def test_epsilon_zero_serves_greedy(self):
+        ex = Explorer(ExploreConfig(policy="epsilon", epsilon=0.0))
+        for _ in range(25):
+            out = ex.rerank(_scores(12))
+            assert [e["item"] for e in out] == [f"i{j}" for j in range(12)]
+        st = ex.stats_json()
+        assert st["queries"] == 25
+        assert st["explored"] == 0 and st["regret"] == 0.0
+
+    def test_epsilon_one_always_explores(self):
+        ex = Explorer(ExploreConfig(policy="epsilon", epsilon=1.0, seed=7))
+        heads = set()
+        for _ in range(60):
+            out = ex.rerank(_scores(12))
+            heads.add(out[0]["item"])
+            # only the head moves; the tail keeps greedy order
+            tail = [e["item"] for e in out if e["item"] != out[0]["item"]]
+            assert tail == sorted(tail, key=lambda s: int(s[1:]))
+        assert len(heads) > 3, heads  # uniform draws hit many arms
+        st = ex.stats_json()
+        assert st["explored"] == 60
+        assert st["regret"] > 0.0
+        assert st["regretPerQuery"] == pytest.approx(st["regret"] / 60)
+
+    def test_deterministic_under_seed(self):
+        a = Explorer(ExploreConfig(policy="epsilon", epsilon=0.5, seed=3))
+        b = Explorer(ExploreConfig(policy="epsilon", epsilon=0.5, seed=3))
+        seq_a = [[e["item"] for e in a.rerank(_scores(9))] for _ in range(20)]
+        seq_b = [[e["item"] for e in b.rerank(_scores(9))] for _ in range(20)]
+        assert seq_a == seq_b
+
+    def test_robust_to_malformed_payloads(self):
+        ex = Explorer(ExploreConfig(policy="epsilon", epsilon=1.0))
+        assert ex.rerank([]) == []
+        one = [{"item": "a", "score": 1.0}]
+        assert ex.rerank(one) == one
+        weird = [{"noscore": True}, {"item": None, "score": "NaN-ish"}]
+        out = ex.rerank(weird)
+        assert len(out) == 2  # served, not crashed
+
+
+class TestThompson:
+    def test_preserves_membership_and_counts_pulls(self):
+        ex = Explorer(ExploreConfig(policy="thompson", seed=1))
+        for _ in range(30):
+            out = ex.rerank(_scores(16))
+            assert sorted(e["item"] for e in out) == sorted(
+                f"i{j}" for j in range(16)
+            )
+        st = ex.stats_json()
+        assert st["queries"] == 30
+        assert st["itemsTracked"] >= 1  # head items accumulate pulls
+
+    def test_posterior_narrows_with_pulls(self):
+        """A widely-pulled item's width shrinks: with every item pulled
+        many times the sampled order converges to greedy."""
+        ex = Explorer(ExploreConfig(policy="thompson", seed=5))
+        from predictionio_tpu.experiments.explore import _ItemStat
+
+        with ex._lock:
+            for j in range(8):
+                st = ex._items[f"i{j}"] = _ItemStat()
+                st.pulls = 100_000
+        greedy = [f"i{j}" for j in range(8)]
+        hits = sum(
+            [e["item"] for e in ex.rerank(_scores(8))] == greedy
+            for _ in range(20)
+        )
+        assert hits >= 18, hits  # near-zero widths: essentially greedy
+
+    def test_reward_events_fold_into_posterior(self):
+        ex = Explorer(ExploreConfig(policy="thompson", reward_event="reward"))
+        ex.rerank(_scores(8))  # track some items
+
+        class _Props:
+            def __init__(self, d):
+                self._d = d
+
+            def opt(self, k):
+                return self._d.get(k)
+
+        class _Event:
+            def __init__(self, name, item, value=None):
+                self.event = name
+                self.target_entity_id = item
+                self.properties = _Props(
+                    {"value": value} if value is not None else {}
+                )
+
+        events = [
+            _Event("reward", "i0", 2.0),
+            _Event("rate", "i1", 5.0),  # not the reward event: ignored
+            {"event": "reward", "targetEntityId": "i1",
+             "properties": {"value": 3.0}},
+            {"event": "reward", "targetEntityId": "never-served"},
+        ]
+        assert ex.note_reward_events(events) == 3
+        st = ex.stats_json()
+        assert st["rewards"]["events"] == 3
+        assert st["rewards"]["valueSum"] == pytest.approx(6.0)  # 2+3+1
+
+
+class TestFeedbackAttribution:
+    def test_variant_and_policy_stamped_dedup_safe(self):
+        """ISSUE 16 satellite: the feedback worker stamps the serving
+        variant and exploration policy into prediction events WITHOUT
+        changing the deterministic ``pio_fb_<prId>`` identity — a
+        retried POST of a stamped event still dedups server-side."""
+        import queue
+        import threading
+
+        from predictionio_tpu.workflow.serving import (
+            FeedbackConfig,
+            QueryService,
+        )
+
+        svc = object.__new__(QueryService)  # no full deploy needed
+        svc.feedback = FeedbackConfig(
+            event_server_url="http://127.0.0.1:1", access_key="k"
+        )
+        svc._feedback_queue = queue.Queue()
+        svc._lock = threading.Lock()
+        svc.feedback_dropped = 0
+        svc.explore_config = ExploreConfig(policy="thompson")
+        svc._send_feedback({"user": "1"}, {"itemScores": []}, "p1", "treatment")
+        _, event = svc._feedback_queue.get_nowait()
+        assert event["eventId"] == "pio_fb_p1"
+        assert event["properties"]["variant"] == "treatment"
+        assert event["properties"]["policy"] == "thompson"
+        # retry of the same prediction: identical eventId, stamped or not
+        svc._send_feedback({"user": "1"}, {"itemScores": []}, "p1", "treatment")
+        _, again = svc._feedback_queue.get_nowait()
+        assert again["eventId"] == event["eventId"]
+        # without experiment state the payload grows no stamp keys
+        svc.explore_config = None
+        svc._send_feedback({"user": "1"}, {"itemScores": []}, "p2")
+        _, bare = svc._feedback_queue.get_nowait()
+        assert "variant" not in bare["properties"]
+        assert "policy" not in bare["properties"]
+
+
+# ------------------------------------------------------------------ sweep
+def _als_candidates(**overrides):
+    from predictionio_tpu.controller import EngineParams
+    from predictionio_tpu.templates.recommendation import (
+        ALSAlgorithmParams,
+        DataSourceParams,
+    )
+
+    ds = DataSourceParams(app_name="sweep-app", eval_k=3)
+    base = dict(rank=4, num_iterations=5)
+    base.update(overrides)
+    return EngineParams, ALSAlgorithmParams, ds, base
+
+
+class TestGridAxes:
+    def test_lambda_seed_sweep_is_compatible(self):
+        EngineParams, ALS, ds, base = _als_candidates()
+        eps = [
+            EngineParams(
+                datasource=ds,
+                algorithms=(("als", ALS(lambda_=lam, seed=s, **base)),),
+            )
+            for lam in (0.01, 0.1, 1.0)
+            for s in (0, 1)
+        ]
+        axes = grid_axes(eps)
+        assert isinstance(axes, GridAxes)
+        assert axes.candidates == 6
+        assert axes.rank == 4 and axes.iterations == 5
+        assert axes.regs[:3] == (0.01, 0.01, 0.1)
+        assert axes.seeds[:2] == (0, 1)
+
+    def test_rank_sweep_is_not_vmappable(self):
+        EngineParams, ALS, ds, base = _als_candidates()
+        base.pop("rank")
+        eps = [
+            EngineParams(
+                datasource=ds, algorithms=(("als", ALS(rank=r, **base)),)
+            )
+            for r in (2, 4)
+        ]
+        assert grid_axes(eps) is None
+
+    def test_mixed_datasource_is_not_vmappable(self):
+        from predictionio_tpu.templates.recommendation import DataSourceParams
+
+        EngineParams, ALS, ds, base = _als_candidates()
+        ds2 = DataSourceParams(app_name="other-app", eval_k=3)
+        eps = [
+            EngineParams(datasource=d, algorithms=(("als", ALS(**base)),))
+            for d in (ds, ds2)
+        ]
+        assert grid_axes(eps) is None
+
+    def test_empty_list(self):
+        assert grid_axes([]) is None
+
+
+class TestGridTrainEval:
+    def test_ranks_regularizers_sensibly(self):
+        """Structured 2-cluster data: a tiny regularizer must beat a
+        crushing one inside the SAME compiled program."""
+        rng = np.random.default_rng(0)
+        U = I = 16
+        R = np.zeros((U, I), np.float32)
+        M = np.zeros((U, I), np.float32)
+        T = np.zeros((U, I), np.float32)
+        seen = np.zeros((U, I), np.float32)
+        for u in range(U):
+            for i in range(I):
+                if (u % 2) == (i % 2):
+                    if rng.random() < 0.6:
+                        R[u, i], M[u, i], seen[u, i] = 5.0, 1.0, 1.0
+                    else:
+                        T[u, i] = 1.0  # held-out same-cluster positive
+                elif rng.random() < 0.4:
+                    R[u, i], M[u, i], seen[u, i] = 1.0, 1.0, 1.0
+        user_w = np.ones((U,), np.float32)
+        item_valid = np.ones((I,), np.float32)
+        scores = np.asarray(
+            grid_train_eval(
+                R, M, T, seen, user_w, item_valid,
+                np.float32([0.05, 5000.0]),
+                np.float32([1.0, 1.0]),
+                np.int32([0, 0]),
+                rank=4, iterations=8, implicit=False, k=3,
+            )
+        )
+        assert scores.shape == (2,)
+        assert scores[0] > scores[1] + 0.05, scores
+
+
+class _FoldTD:
+    """Duck-typed TrainingData for fold_arrays (COO + BiMaps)."""
+
+    def __init__(self, n_users, n_items, triples):
+        from predictionio_tpu.data.aggregator import BiMap
+
+        self.user_index = BiMap.string_index(str(u) for u in range(n_users))
+        self.item_index = BiMap.string_index(f"i{i}" for i in range(n_items))
+        self.rows = np.int64([t[0] for t in triples])
+        self.cols = np.int64([t[1] for t in triples])
+        self.vals = np.float32([t[2] for t in triples])
+
+
+class _Q:
+    def __init__(self, user):
+        self.user = user
+
+
+class _A:
+    def __init__(self, items, seen=()):
+        self.items = items
+        self.seen = seen
+
+
+class TestFoldArrays:
+    def test_pads_and_masks(self):
+        td = _FoldTD(5, 6, [(0, 0, 4.0), (1, 2, 3.0)])
+        qa = [
+            (_Q("0"), _A(["i1"], seen=["i0"])),
+            (_Q("ghost"), _A(["i1"])),  # unknown user: skipped
+        ]
+        arrays, n_eval, k_eff = fold_arrays(td, qa, k=10)
+        assert n_eval == 1
+        assert k_eff == 6  # clamped to the real catalog
+        assert arrays["R"].shape == (8, 8)  # pow2 padding
+        assert arrays["item_valid"].sum() == 6.0
+        assert arrays["seen"][0].sum() == 1.0
+        assert arrays["T"][0].sum() == 1.0
+
+    def test_empty_fold(self):
+        td = _FoldTD(3, 3, [(0, 0, 1.0)])
+        arrays, n_eval, _ = fold_arrays(td, [], k=5)
+        assert arrays is None and n_eval == 0
+
+
+@pytest.fixture()
+def sweep_app(memory_storage_env):
+    """Same 2-cluster shape as the recommendation e2e fixture, smaller."""
+    from predictionio_tpu.data.event import DataMap, Event
+    from predictionio_tpu.data.storage.base import App
+
+    Storage = memory_storage_env
+    app_id = Storage.get_meta_data_apps().insert(App(id=0, name="sweep-app"))
+    le = Storage.get_l_events()
+    le.init(app_id)
+    rng = np.random.default_rng(1)
+    for u in range(24):
+        for i in range(16):
+            same = (i % 2) == (u % 2)
+            if same and rng.random() < 0.9:
+                rating = float(rng.integers(4, 6))
+            elif not same and rng.random() < 0.5:
+                rating = float(rng.integers(1, 3))
+            else:
+                continue
+            le.insert(
+                Event(
+                    event="rate",
+                    entity_type="user",
+                    entity_id=str(u),
+                    target_entity_type="item",
+                    target_entity_id=str(i),
+                    properties=DataMap({"rating": rating}),
+                ),
+                app_id,
+            )
+    return Storage
+
+
+class TestRunGridEvaluation:
+    def _evaluation(self):
+        from predictionio_tpu.controller import Evaluation
+        from predictionio_tpu.templates.recommendation import engine_factory
+        from predictionio_tpu.templates.recommendation.engine import (
+            PrecisionAtK,
+        )
+
+        return Evaluation(engine=engine_factory(), metric=PrecisionAtK(5))
+
+    def test_vmapped_grid_completes_and_ranks(self, sweep_app):
+        from predictionio_tpu.controller import (
+            EngineParamsGenerator,
+            local_context,
+        )
+
+        EngineParams, ALS, ds, base = _als_candidates()
+        candidates = [
+            EngineParams(
+                datasource=ds,
+                algorithms=(("als", ALS(lambda_=lam, seed=s, **base)),),
+            )
+            for lam in (0.01, 0.1, 1000.0)
+            for s in (0, 1)
+        ]
+        assert grid_axes(candidates) is not None  # vmapped path taken
+        instance, result = run_grid_evaluation(
+            self._evaluation(),
+            EngineParamsGenerator(candidates),
+            local_context(),
+        )
+        assert instance.status == "EVALCOMPLETED"
+        assert len(result.engine_params_scores) == 6
+        assert sorted(result.ranking) == list(range(6))
+        assert result.best_index == result.ranking[0]
+        # the crushing regularizer candidates (lambda=1000) lose to the
+        # well-regularized ones
+        crushed = {4, 5}
+        assert result.best_index not in crushed
+        best = result.best_score.score
+        worst = min(
+            s.score for i, (_, s) in enumerate(result.engine_params_scores)
+            if i in crushed
+        )
+        assert best > worst
+        assert "Metric:" in result.leaderboard()
+        # persisted like run_evaluation: the dashboard reads this record
+        stored = (
+            sweep_app.get_meta_data_evaluation_instances().get(instance.id)
+        )
+        assert stored.status == "EVALCOMPLETED"
+        assert stored.evaluator_results_json
+
+    def test_incompatible_grid_falls_back_sequential(self, sweep_app):
+        from predictionio_tpu.controller import (
+            EngineParamsGenerator,
+            local_context,
+        )
+
+        # sweep num_iterations (not a SWEEP_AXES member) at one small
+        # rank: incompatible for vmapping, but both sequential template
+        # trains share the same compiled step shapes
+        EngineParams, ALS, ds, base = _als_candidates(rank=2)
+        base.pop("num_iterations")
+        candidates = [
+            EngineParams(
+                datasource=ds, algorithms=(("als", ALS(num_iterations=n, **base)),)
+            )
+            for n in (1, 2)
+        ]
+        assert grid_axes(candidates) is None  # forces the fallback
+        instance, result = run_grid_evaluation(
+            self._evaluation(),
+            EngineParamsGenerator(candidates),
+            local_context(),
+        )
+        assert instance.status == "EVALCOMPLETED"
+        assert len(result.engine_params_scores) == 2

@@ -1,0 +1,389 @@
+"""A/B traffic-split tests (ISSUE 16 satellite: split determinism and
+failover). The stickiness story is structural — assignment is a pure
+function of (salt, variant weights, affinity key) — so the tests assert
+it survives exactly the events that break table-based assignment:
+router restart (fresh process state), replica SIGKILL mid-experiment
+(failover must not re-roll the variant), and fleet membership change
+(the replica ring re-shuffles, the variant split must not). Plus the
+adversarial-scope guarantee: variant-tagged cache keys can never
+collide across variants for ANY scope string.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from collections import Counter
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from predictionio_tpu.experiments.split import (
+    SplitConfig,
+    TrafficSplit,
+    Variant,
+)
+from predictionio_tpu.fleet import ModelRegistry, RouterConfig, RouterService
+
+
+# ------------------------------------------------------------------ unit
+class TestSplitConfig:
+    def test_parse_weights(self):
+        cfg = SplitConfig.parse("control:2,treatment:1")
+        assert [(v.name, v.weight) for v in cfg.variants] == [
+            ("control", 2.0),
+            ("treatment", 1.0),
+        ]
+        assert cfg.enabled
+
+    def test_parse_bare_names_default_weight(self):
+        cfg = SplitConfig.parse("a, b ,c")
+        assert [v.weight for v in cfg.variants] == [1.0, 1.0, 1.0]
+
+    def test_parse_rejects_single_variant(self):
+        with pytest.raises(ValueError, match="at least two"):
+            SplitConfig.parse("lonely")
+
+    def test_parse_rejects_bad_weight(self):
+        with pytest.raises(ValueError, match="not a number"):
+            SplitConfig.parse("a:x,b:1")
+
+    @pytest.mark.parametrize("bad", ["a|b", "a:b", "a,b", "", "a b", "x" * 65])
+    def test_separator_and_junk_names_rejected(self, bad):
+        # '|' and ':' must be unrepresentable in names — the cache-key
+        # namespacing proof depends on it
+        with pytest.raises(ValueError):
+            Variant(name=bad)
+
+    def test_duplicate_names_rejected(self):
+        with pytest.raises(ValueError, match="duplicate"):
+            SplitConfig(variants=(Variant("a"), Variant("a")))
+
+
+class TestAssignment:
+    def test_deterministic_across_instances(self):
+        cfg = SplitConfig.parse("control:2,treatment:1")
+        a, b = TrafficSplit(cfg), TrafficSplit(cfg)
+        keys = [f"s:u{i}" for i in range(2000)]
+        assert [a.assign(k) for k in keys] == [b.assign(k) for k in keys]
+
+    def test_weighted_distribution(self):
+        split = TrafficSplit(SplitConfig.parse("control:2,treatment:1"))
+        counts = Counter(split.assign(f"s:u{i}") for i in range(6000))
+        frac = counts["control"] / 6000
+        assert 0.62 < frac < 0.71, counts  # 2/3 +- hash noise
+
+    def test_zero_weight_never_assigned(self):
+        split = TrafficSplit(
+            SplitConfig(variants=(Variant("on", 1.0), Variant("off", 0.0)))
+        )
+        assert {split.assign(f"k{i}") for i in range(500)} == {"on"}
+
+    def test_none_key_pins_first_variant(self):
+        split = TrafficSplit(SplitConfig.parse("a:1,b:1"))
+        assert split.assign(None) == "a"
+
+    def test_salt_changes_assignment(self):
+        keys = [f"s:u{i}" for i in range(500)]
+        a = TrafficSplit(SplitConfig.parse("a:1,b:1"))
+        b = TrafficSplit(SplitConfig.parse("a:1,b:1", salt="other"))
+        assert [a.assign(k) for k in keys] != [b.assign(k) for k in keys]
+
+    def test_adversarial_scopes_never_collide_across_variants(self):
+        """f"{variant}|{key}" tags are injective: the first '|' always
+        terminates the (separator-free) variant name, so an adversarial
+        scope embedding '|', 'v=', or another variant's name cannot make
+        two (variant, key) pairs share a tag."""
+        variants = ["control", "treatment", "b", "a.b-c_d"]
+        keys = [
+            "a|b", "b", "a", "a|", "|b", "v=control|x", "control",
+            "control|u1", "treatment|control", "", "🦊|🦊", "a:b",
+            "s:u1|s:u2", "\x00", "||||",
+        ]
+        tags = {}
+        for v in variants:
+            for k in keys:
+                tag = f"{v}|{k}"
+                assert tag not in tags, (tags[tag], (v, k))
+                tags[tag] = (v, k)
+        # and each tag parses back unambiguously
+        for tag, (v, k) in tags.items():
+            head, _, tail = tag.partition("|")
+            assert (head, tail) == (v, k)
+
+    def test_promote_collapses_traffic_and_stamps(self):
+        split = TrafficSplit(SplitConfig.parse("control:2,treatment:1"))
+        split.note_routed("treatment", 0.01)
+        stamp = split.promote("treatment")
+        assert stamp["variant"] == "treatment"
+        assert stamp["weightsBefore"] == {"control": 2.0, "treatment": 1.0}
+        assert {split.assign(f"k{i}") for i in range(300)} == {"treatment"}
+        stats = split.stats_json()
+        assert stats["promoted"]["variant"] == "treatment"
+        # counters survive promotion: the experiment's history remains
+        by_name = {v["name"]: v for v in stats["variants"]}
+        assert by_name["treatment"]["routed"] == 1
+
+    def test_promote_unknown_variant_raises(self):
+        split = TrafficSplit(SplitConfig.parse("a:1,b:1"))
+        with pytest.raises(ValueError, match="unknown variant"):
+            split.promote("nope")
+
+    def test_stats_percentiles_and_rewards(self):
+        split = TrafficSplit(SplitConfig.parse("a:1,b:1"))
+        for ms in (1, 2, 3, 100):
+            split.note_routed("a", ms / 1000.0)
+        split.note_routed("a", 0.005, ok=False)
+        split.note_reward("a", 2.0)
+        split.note_reward("a")
+        sa = {v["name"]: v for v in split.stats_json()["variants"]}["a"]
+        assert sa["routed"] == 5 and sa["errors"] == 1
+        assert sa["rewardCount"] == 2 and sa["rewardSum"] == 3.0
+        assert sa["p50Ms"] is not None and sa["p99Ms"] >= sa["p50Ms"]
+        # unknown variant names are ignored, not crashed on
+        split.note_routed("ghost", 0.001)
+        split.note_reward("ghost")
+
+
+# ----------------------------------------------------------- integration
+class _EchoReplica:
+    """Stub replica that echoes the received X-PIO-Variant header back in
+    the response body — the probe for cross-variant serving."""
+
+    def __init__(self, rid: str):
+        self.rid = rid
+        self.generation = 1
+        self.dead = False
+        self.served: list[tuple[str, str | None]] = []  # (user, variant)
+        self._lock = threading.Lock()
+        stub = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def _json(self, status, payload):
+                raw = json.dumps(payload).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(raw)))
+                self.send_header("X-PIO-Generation", str(stub.generation))
+                self.end_headers()
+                self.wfile.write(raw)
+
+            def do_GET(self):
+                if stub.dead:
+                    self.close_connection = True
+                    return
+                self._json(
+                    200,
+                    {
+                        "ready": True,
+                        "generation": stub.generation,
+                        "replicaId": stub.rid,
+                        "engineInstanceId": "inst-1",
+                    },
+                )
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length) if length else b""
+                if stub.dead:
+                    self.close_connection = True
+                    return
+                if self.path == "/reload":
+                    stub.generation += 1
+                    self._json(200, {"message": "Reloaded"})
+                    return
+                parsed = json.loads(body) if body else {}
+                variant = self.headers.get("X-PIO-Variant")
+                with stub._lock:
+                    stub.served.append((parsed.get("user"), variant))
+                self._json(
+                    200, {"replica": stub.rid, "servedVariant": variant}
+                )
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self.server.server_address[1]
+        threading.Thread(target=self.server.serve_forever, daemon=True).start()
+
+    def close(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+@pytest.fixture()
+def echo_replicas():
+    created: list[_EchoReplica] = []
+
+    def make(n: int) -> list[_EchoReplica]:
+        for i in range(n):
+            created.append(_EchoReplica(f"r{i}"))
+        return created
+
+    yield make
+    for s in created:
+        s.close()
+
+
+def _router(replicas, split, registry=None) -> RouterService:
+    router = RouterService(
+        [(s.rid, "127.0.0.1", s.port) for s in replicas],
+        RouterConfig(probe_interval_s=0.05, drain_wait_s=0.2,
+                     reload_timeout_s=5.0),
+        registry=registry,
+        split=split,
+    )
+    router.probe_all()
+    return router
+
+
+def _query_variant(router: RouterService, user: str) -> str:
+    wire = router.route_query({"user": user, "num": 4}, {})
+    assert wire.status == 200, wire.body
+    assert wire.raw is not None
+    body = json.loads(wire.raw)
+    header = wire.headers.get("X-PIO-Variant")
+    # the replica served exactly the variant the router assigned — a
+    # mismatch would be a cross-variant result
+    assert body["servedVariant"] == header, (body, header)
+    return header
+
+
+class TestRouterSplit:
+    CFG = "control:2,treatment:1"
+
+    def test_sticky_across_router_restart(self, echo_replicas):
+        reps = echo_replicas(2)
+        first = {}
+        router = _router(reps, TrafficSplit(SplitConfig.parse(self.CFG)))
+        for u in range(40):
+            first[u] = _query_variant(router, f"u{u}")
+        router.close()
+        # a brand-new router process: fresh TrafficSplit, fresh key-gen
+        # map, same experiment config
+        router2 = _router(reps, TrafficSplit(SplitConfig.parse(self.CFG)))
+        for u in range(40):
+            assert _query_variant(router2, f"u{u}") == first[u]
+        router2.close()
+
+    def test_sticky_through_replica_kill(self, echo_replicas):
+        reps = echo_replicas(2)
+        router = _router(reps, TrafficSplit(SplitConfig.parse(self.CFG)))
+        before = {u: _query_variant(router, f"u{u}") for u in range(30)}
+        reps[0].dead = True  # SIGKILL: sockets drop mid-request
+        router.probe_all()
+        for u in range(30):
+            assert _query_variant(router, f"u{u}") == before[u]
+        assert all(v is not None for v in before.values())
+        router.close()
+
+    def test_sticky_across_membership_change(self, echo_replicas):
+        reps = echo_replicas(3)
+        split_cfg = SplitConfig.parse(self.CFG)
+        router3 = _router(reps, TrafficSplit(split_cfg))
+        with3 = {u: _query_variant(router3, f"u{u}") for u in range(40)}
+        router3.close()
+        # the replica ring shrinks (keys re-shard onto 2 backends) but
+        # the experiment split must not move a single scope
+        router2 = _router(reps[:2], TrafficSplit(split_cfg))
+        for u in range(40):
+            assert _query_variant(router2, f"u{u}") == with3[u]
+        router2.close()
+
+    def test_key_generation_tags_are_per_variant(self, echo_replicas):
+        reps = echo_replicas(2)
+        split = TrafficSplit(SplitConfig.parse(self.CFG))
+        router = _router(reps, split)
+        for u in range(20):
+            _query_variant(router, f"u{u}")
+        with router._key_gens_lock:
+            tags = list(router._key_gens)
+        assert tags, "keyed queries must record generation tags"
+        names = set(split.variant_names())
+        for tag in tags:
+            head, sep, tail = tag.partition("|")
+            assert sep and head in names and tail, tag
+        router.close()
+
+    def test_per_variant_stats_and_promote_rolls_fleet(
+        self, echo_replicas, tmp_path
+    ):
+        reps = echo_replicas(2)
+        split = TrafficSplit(SplitConfig.parse(self.CFG))
+        registry = ModelRegistry(str(tmp_path))
+        router = _router(reps, split, registry=registry)
+        served = Counter(_query_variant(router, f"u{u}") for u in range(60))
+        assert set(served) == {"control", "treatment"}
+        stats = router.stats_json()["experiments"]
+        by_name = {v["name"]: v for v in stats["variants"]}
+        assert by_name["control"]["routed"] == served["control"]
+        assert by_name["treatment"]["routed"] == served["treatment"]
+        assert by_name["control"]["p50Ms"] is not None
+
+        # reward fold-back through the router route, variant re-derived
+        # from the scope fields
+        wire = router.dispatch(
+            "POST", "/experiments/reward.json", {},
+            body=[{"user": "u0", "value": 2.0}, {"variant": "treatment"}],
+        )
+        assert wire.status == 200 and wire.body["matched"] == 2
+
+        gens_before = {r.generation for r in router.replicas}
+        wire = router.dispatch(
+            "POST", "/experiments/promote.json", {},
+            body={"variant": "treatment"},
+        )
+        assert wire.status == 200, wire.body
+        report = wire.body
+        assert report["promotion"]["variant"] == "treatment"
+        # the rolling reload converged the fleet on a NEWER generation
+        assert report["reload"]["converged"]
+        assert {r.generation for r in router.replicas} != gens_before
+        # registry stamped with the experiment outcome
+        current = registry.current()
+        assert current.meta["source"] == "experiment_promotion"
+        assert current.meta["variant"] == "treatment"
+        # all traffic now lands on the winner, with zero failed queries
+        assert all(
+            _query_variant(router, f"u{u}") == "treatment" for u in range(30)
+        )
+        # GET /experiments.json surfaces the promotion
+        wire = router.dispatch("GET", "/experiments.json", {})
+        assert wire.status == 200
+        assert wire.body["promoted"]["variant"] == "treatment"
+        assert wire.body["registryPromotion"]["variant"] == "treatment"
+        router.close()
+
+    def test_promote_unknown_variant_404(self, echo_replicas):
+        reps = echo_replicas(1)
+        router = _router(reps, TrafficSplit(SplitConfig.parse(self.CFG)))
+        wire = router.dispatch(
+            "POST", "/experiments/promote.json", {}, body={"variant": "zzz"}
+        )
+        assert wire.status == 404
+        wire = router.dispatch("POST", "/experiments/promote.json", {}, body={})
+        assert wire.status == 400
+        router.close()
+
+    def test_experiment_routes_404_without_split(self, echo_replicas):
+        reps = echo_replicas(1)
+        router = RouterService(
+            [(s.rid, "127.0.0.1", s.port) for s in reps],
+            RouterConfig(probe_interval_s=0.05),
+        )
+        router.probe_all()
+        for method, path in (
+            ("GET", "/experiments.json"),
+            ("POST", "/experiments/promote.json"),
+            ("POST", "/experiments/reward.json"),
+        ):
+            assert router.dispatch(method, path, {}, body={}).status == 404
+        # split-less routing carries no variant header at all
+        wire = router.route_query({"user": "u1", "num": 4}, {})
+        assert wire.status == 200
+        assert "X-PIO-Variant" not in wire.headers
+        assert json.loads(wire.raw)["servedVariant"] is None
+        router.close()
