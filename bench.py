@@ -2075,6 +2075,34 @@ def _bench_serving_fleet() -> dict:
     return run_chaos_serve(cfg)
 
 
+def _bench_fleet_elastic() -> dict:
+    """Cross-host elastic fleet (ISSUE 17): one run of the chaos-fleet
+    drill — two single-replica "hosts" (separate basedirs, separate
+    routers) share one endpoint registry; SIGKILL an entire host's tree
+    under never-retrying HA clients (zero failed queries, surviving
+    router absorbs + evicts on lease expiry, restarted host rejoins),
+    then a watermark scale-up/drain-aware scale-down cycle, then the
+    stale-while-down cache contract. Stdlib harness over real ``pio
+    deploy --replicas --endpoint-registry`` subprocess fleets."""
+    from predictionio_tpu.resilience.chaos import (
+        FleetChaosConfig,
+        run_chaos_fleet,
+    )
+
+    cfg = FleetChaosConfig(
+        replicas_per_host=int(os.environ.get("BENCH_ELASTIC_REPLICAS", 1)),
+        clients=int(os.environ.get("BENCH_ELASTIC_CLIENTS", 16)),
+        phase_seconds=float(os.environ.get("BENCH_ELASTIC_SECONDS", 4.0)),
+        train_events=int(os.environ.get("BENCH_ELASTIC_EVENTS", 300)),
+        train_users=int(os.environ.get("BENCH_ELASTIC_USERS", 48)),
+        train_items=int(os.environ.get("BENCH_ELASTIC_ITEMS", 96)),
+        lease_ttl_s=float(os.environ.get("BENCH_ELASTIC_LEASE_S", 1.0)),
+        autoscale_phase=os.environ.get("BENCH_ELASTIC_AUTOSCALE", "1") != "0",
+        stale_phase=os.environ.get("BENCH_ELASTIC_STALE", "1") != "0",
+    )
+    return run_chaos_fleet(cfg)
+
+
 def _bench_chaos_ingest(cycles: int, writers: int, events: int) -> dict:
     """Crash-safety drill (ISSUE 5 acceptance): SIGKILL a real event-
     server subprocess >= `cycles` times under concurrent retrying
@@ -3499,6 +3527,20 @@ def main() -> None:
         os.environ["BENCH_EXP_SWEEP_USERS"] = "48"
         os.environ["BENCH_EXP_DRILL_CLIENTS"] = "8"
         os.environ["BENCH_EXP_DRILL_QUERIES"] = "25"
+        # elastic-fleet drill (ISSUE 17): two one-replica "hosts" on a
+        # shared endpoint registry, whole-host SIGKILL under HA clients,
+        # a 1->2->1 autoscale walk, and the stale-while-down probe —
+        # five subprocess fleet cold-starts, so phases stay short
+        os.environ["BENCH_FLEET_ELASTIC"] = "1"
+        os.environ["BENCH_ELASTIC_REPLICAS"] = "1"
+        os.environ["BENCH_ELASTIC_CLIENTS"] = "16"
+        os.environ["BENCH_ELASTIC_SECONDS"] = "3"
+        os.environ["BENCH_ELASTIC_EVENTS"] = "300"
+        os.environ["BENCH_ELASTIC_USERS"] = "48"
+        os.environ["BENCH_ELASTIC_ITEMS"] = "96"
+        os.environ["BENCH_ELASTIC_LEASE_S"] = "1.0"
+        os.environ["BENCH_ELASTIC_AUTOSCALE"] = "1"
+        os.environ["BENCH_ELASTIC_STALE"] = "1"
         os.environ.pop("BENCH_PRECISION_COMPARE", None)
         # fresh compile cache: a persistent cache populated on a different
         # host can carry AOT results whose CPU features mismatch (SIGILL risk)
@@ -3659,6 +3701,12 @@ def main() -> None:
             detail["serving_fleet"] = _bench_serving_fleet()
         except Exception as e:
             detail["serving_fleet"] = {"error": str(e)[:300]}
+
+    if os.environ.get("BENCH_FLEET_ELASTIC", "1") != "0":
+        try:
+            detail["fleet_elastic"] = _bench_fleet_elastic()
+        except Exception as e:
+            detail["fleet_elastic"] = {"error": str(e)[:300]}
 
     if os.environ.get("BENCH_EXPERIMENTS", "1") != "0":
         try:

@@ -21,11 +21,19 @@ model-parallel serving beyond one host — PAPERS.md):
 * :mod:`predictionio_tpu.fleet.registry` — a generation-stamped model
   registry over shared-filesystem storage, so every replica of a fleet
   (and every fleet of a cluster) agrees on which model generation is
-  being rolled out;
+  being rolled out — plus the **endpoint registry**: lease-stamped
+  per-replica entry files through which replicas on ANY host
+  self-report their port-0-bound address and join the ring (``pio
+  deploy --endpoint-registry DIR``), with expiry-based eviction claimed
+  exactly once across an HA router pair;
 * :mod:`predictionio_tpu.fleet.supervisor` — spawns the N query-server
   subprocesses, respawns any that die, and records the fleet topology
-  where operators (``pio status``) and the chaos drill
-  (``pio chaos-serve``) can find it.
+  where operators (``pio status``) and the chaos drills
+  (``pio chaos-serve``, ``pio chaos-fleet``) can find it; the
+  autoscaler adds/retires replicas through it at runtime;
+* :mod:`predictionio_tpu.fleet.autoscaler` — watermark-driven elastic
+  capacity (``--autoscale MIN:MAX``): scale-up on q/s or p99 pressure,
+  drain-aware scale-down that loses zero in-flight queries.
 
 Stdlib-only by contract (piolint manifest): the fleet layer is host
 orchestration over HTTP and must run with no jax, numpy, or storage
@@ -38,7 +46,13 @@ and serving is byte-identical (tests/test_ci_guards.py).
 
 from __future__ import annotations
 
-from predictionio_tpu.fleet.registry import ModelRegistry, RegistryRecord
+from predictionio_tpu.fleet.autoscaler import Autoscaler, AutoscalerConfig
+from predictionio_tpu.fleet.registry import (
+    EndpointRecord,
+    EndpointRegistry,
+    ModelRegistry,
+    RegistryRecord,
+)
 from predictionio_tpu.fleet.ring import HashRing
 from predictionio_tpu.fleet.router import (
     ReplicaState,
@@ -53,6 +67,10 @@ from predictionio_tpu.fleet.supervisor import (
 )
 
 __all__ = [
+    "Autoscaler",
+    "AutoscalerConfig",
+    "EndpointRecord",
+    "EndpointRegistry",
     "FleetSupervisor",
     "HashRing",
     "ModelRegistry",

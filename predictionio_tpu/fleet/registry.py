@@ -1,10 +1,26 @@
-"""Generation-stamped model registry over shared-filesystem storage.
+"""Shared-filesystem fleet registries: model generations and endpoints.
+
+Two ledgers live here, both plain JSON on a filesystem every fleet host
+mounts (the same sharedfs idiom the storage layer's ``TYPE=sharedfs``
+driver uses), both readable with nothing installed:
+
+* :class:`ModelRegistry` — ONE document answering "which model should
+  every replica be serving?" (generation-stamped, atomic rename).
+* :class:`EndpointRegistry` — a DIRECTORY of per-replica entry files
+  answering "which replicas exist right now, and where?". Replicas bind
+  port 0, then announce their *actually bound* address here (closing the
+  pick-then-spawn loopback TOCTOU for good: nothing ever picks a port it
+  has not already bound), and keep the entry alive with heartbeat
+  leases. Routers on ANY host reconcile their consistent-hash ring from
+  the live entries; an entry whose lease expired is **evicted exactly
+  once** across however many routers share the directory (atomic
+  rename-claim), so an HA router pair never double-counts a membership
+  change. Torn or unparsable entry files are surfaced as loud
+  ``problems``, never silently skipped.
 
 A fleet needs one answer to "which model should every replica be
 serving?". Each replica's in-process reload counter says where *that
-process* is; the registry says where the *fleet* should converge. It is
-a single JSON document on a filesystem every replica host mounts (the
-same sharedfs idiom the storage layer's ``TYPE=sharedfs`` driver uses):
+process* is; the registry says where the *fleet* should converge:
 
 * ``publish(instance_id)`` — stamp a new fleet generation pointing at a
   trained engine instance. Atomic (tmp + fsync + rename) so a reader
@@ -31,9 +47,15 @@ import datetime as _dt
 import json
 import os
 import tempfile
+import time
 from typing import Any
 
-__all__ = ["ModelRegistry", "RegistryRecord"]
+__all__ = [
+    "EndpointRecord",
+    "EndpointRegistry",
+    "ModelRegistry",
+    "RegistryRecord",
+]
 
 _HISTORY_LIMIT = 50
 
@@ -145,3 +167,253 @@ class ModelRegistry:
             except FileNotFoundError:
                 pass
         return record
+
+
+# ---------------------------------------------------------------------------
+# Endpoint registry (cross-host replica discovery; ISSUE 17)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class EndpointRecord:
+    """One replica's self-reported address + lease."""
+
+    replica_id: str
+    host: str
+    port: int
+    generation: int = 0
+    #: wall-clock epoch seconds the lease expires at (wall clock, not
+    #: monotonic: the whole point is cross-process, cross-host validity)
+    lease_expires: float = 0.0
+    announced_at: float = 0.0
+    meta: dict | None = None
+
+    def to_json(self) -> dict:
+        out: dict[str, Any] = {
+            "replicaId": self.replica_id,
+            "host": self.host,
+            "port": self.port,
+            "generation": self.generation,
+            "leaseExpires": self.lease_expires,
+            "announcedAt": self.announced_at,
+        }
+        if self.meta:
+            out["meta"] = dict(self.meta)
+        return out
+
+    @staticmethod
+    def from_json(d: dict) -> "EndpointRecord":
+        return EndpointRecord(
+            replica_id=str(d["replicaId"]),
+            host=str(d["host"]),
+            port=int(d["port"]),
+            generation=int(d.get("generation", 0)),
+            lease_expires=float(d.get("leaseExpires", 0.0)),
+            announced_at=float(d.get("announcedAt", 0.0)),
+            meta=d.get("meta"),
+        )
+
+    def lease_age_s(self, now: float | None = None) -> float:
+        """Seconds since the entry was last (re)announced."""
+        return max(0.0, (time.time() if now is None else now) - self.announced_at)
+
+    def live(self, now: float | None = None) -> bool:
+        return (time.time() if now is None else now) < self.lease_expires
+
+
+class EndpointRegistry:
+    """Directory of lease-stamped endpoint entries, one file per replica.
+
+    Every write is atomic (tmp + fsync + rename in the same directory),
+    so a reader sees either the previous whole entry or the next whole
+    entry — two writers racing on the same ``replica_id`` converge on
+    whichever rename lands last, never on a torn file. Filenames are
+    derived from the replica id through a character allow-list, so an
+    adversarial id cannot escape the directory.
+    """
+
+    #: entry filename suffix — anything else in the directory is ignored
+    SUFFIX = ".endpoint.json"
+    #: suffix an eviction claim renames the losing entry to before unlink
+    _EVICT_SUFFIX = ".evicting"
+
+    def __init__(self, directory: str, lease_ttl_s: float = 5.0):
+        if lease_ttl_s <= 0:
+            raise ValueError("lease_ttl_s must be > 0")
+        self.directory = directory
+        self.lease_ttl_s = float(lease_ttl_s)
+        self._claim_seq = 0
+
+    # --------------------------------------------------------------- paths
+    def _entry_path(self, replica_id: str) -> str:
+        safe = "".join(
+            c if c.isalnum() or c in "._-" else "_" for c in replica_id
+        )[:128]
+        if not safe:
+            raise ValueError(f"unusable replica id {replica_id!r}")
+        return os.path.join(self.directory, safe + self.SUFFIX)
+
+    # --------------------------------------------------------------- write
+    def announce(
+        self,
+        replica_id: str,
+        host: str,
+        port: int,
+        generation: int = 0,
+        meta: dict | None = None,
+        now: float | None = None,
+    ) -> EndpointRecord:
+        """Publish (or renew — a heartbeat IS a re-announce) one
+        replica's bound address with a fresh lease."""
+        now = time.time() if now is None else now
+        record = EndpointRecord(
+            replica_id=replica_id,
+            host=host,
+            port=int(port),
+            generation=int(generation),
+            lease_expires=now + self.lease_ttl_s,
+            announced_at=now,
+            meta=meta,
+        )
+        os.makedirs(self.directory, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(
+            prefix=".endpoint.", suffix=".tmp", dir=self.directory
+        )
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump(record.to_json(), f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self._entry_path(replica_id))
+        finally:
+            try:
+                os.unlink(tmp)
+            except FileNotFoundError:
+                pass
+        return record
+
+    def heartbeat(
+        self,
+        replica_id: str,
+        host: str,
+        port: int,
+        generation: int = 0,
+        meta: dict | None = None,
+    ) -> EndpointRecord:
+        """Lease renewal — an atomic whole-entry rewrite, so a heartbeat
+        racing an eviction claim simply re-creates the entry (the replica
+        is alive; the claim evicted a lease that was genuinely stale when
+        claimed)."""
+        return self.announce(
+            replica_id, host, port, generation=generation, meta=meta
+        )
+
+    def withdraw(self, replica_id: str) -> bool:
+        """Clean retirement: remove the entry now instead of letting the
+        lease run out. Returns whether an entry was actually removed."""
+        try:
+            os.unlink(self._entry_path(replica_id))
+            return True
+        except FileNotFoundError:
+            return False
+
+    # ---------------------------------------------------------------- read
+    def snapshot(
+        self, now: float | None = None
+    ) -> tuple[list[EndpointRecord], list[EndpointRecord], list[dict]]:
+        """Read every entry: ``(live, expired, problems)``.
+
+        ``problems`` carries one dict per torn/unparsable entry file —
+        loud, never silently dropped: ``pio status`` prints them and the
+        router surfaces them on ``/fleet/endpoints.json``. Expired
+        entries are returned separately so callers can distinguish "gone"
+        from "lease ran out but not yet evicted"."""
+        now = time.time() if now is None else now
+        live: list[EndpointRecord] = []
+        expired: list[EndpointRecord] = []
+        problems: list[dict] = []
+        try:
+            names = sorted(os.listdir(self.directory))
+        except FileNotFoundError:
+            return [], [], []
+        for name in names:
+            if not name.endswith(self.SUFFIX):
+                continue
+            path = os.path.join(self.directory, name)
+            try:
+                with open(path) as f:
+                    record = EndpointRecord.from_json(json.load(f))
+            except FileNotFoundError:
+                continue  # lost a race with withdraw/evict — fine
+            except (json.JSONDecodeError, KeyError, TypeError, ValueError,
+                    OSError) as e:
+                problems.append(
+                    {"file": name, "error": f"{type(e).__name__}: {e}"[:200]}
+                )
+                continue
+            (live if record.live(now) else expired).append(record)
+        return live, expired, problems
+
+    def live(self, now: float | None = None) -> list[EndpointRecord]:
+        return self.snapshot(now)[0]
+
+    # ---------------------------------------------------------------- evict
+    def evict_expired(self, now: float | None = None) -> list[str]:
+        """Remove entries whose lease expired (and torn entry files older
+        than one lease), returning the replica ids THIS caller evicted.
+
+        Exactly-once across concurrent callers: each eviction first
+        claims the entry with an atomic ``os.rename`` to a caller-unique
+        name — of N racing routers exactly one rename succeeds, and only
+        the winner counts (and unlinks) the eviction. The losers see
+        ``FileNotFoundError`` and report nothing, so an HA router pair
+        never double-counts one membership change."""
+        now = time.time() if now is None else now
+        evicted: list[str] = []
+        try:
+            names = sorted(os.listdir(self.directory))
+        except FileNotFoundError:
+            return []
+        for name in names:
+            if not name.endswith(self.SUFFIX):
+                continue
+            path = os.path.join(self.directory, name)
+            stale_unparsable = False
+            try:
+                with open(path) as f:
+                    record = EndpointRecord.from_json(json.load(f))
+            except FileNotFoundError:
+                continue
+            except (json.JSONDecodeError, KeyError, TypeError, ValueError,
+                    OSError):
+                record = None
+                try:
+                    stale_unparsable = (
+                        now - os.path.getmtime(path) > self.lease_ttl_s
+                    )
+                except OSError:
+                    continue
+            if record is not None and record.live(now):
+                continue
+            if record is None and not stale_unparsable:
+                continue  # torn but fresh: give its writer a lease to fix it
+            self._claim_seq += 1
+            claim = (
+                f"{path}{self._EVICT_SUFFIX}.{os.getpid()}.{self._claim_seq}"
+            )
+            try:
+                os.rename(path, claim)  # the atomic exactly-once gate
+            except FileNotFoundError:
+                continue  # another router (or a heartbeat) won this entry
+            except OSError:
+                continue
+            try:
+                os.unlink(claim)
+            except OSError:
+                pass
+            evicted.append(
+                record.replica_id
+                if record is not None
+                else name[: -len(self.SUFFIX)]
+            )
+        return evicted

@@ -50,6 +50,18 @@ owns no model — it owns the *availability contract*:
   answers ``503`` immediately with a ``taxonomy`` field
   (``breaker_open`` vs ``no_healthy_replicas``) and a ``Retry-After``
   derived from the breaker reset — no retry storm, no stacked timeouts.
+* **Elastic membership** (opt-in, ``endpoint_registry=``) — the monitor
+  thread reconciles the ring from the shared
+  :class:`~predictionio_tpu.fleet.registry.EndpointRegistry` each probe
+  interval: replicas that announced join, replicas whose lease expired
+  are evicted (exactly once across an HA router pair — the registry's
+  rename-claim guarantees it) and leave the ring. ``GET
+  /fleet/endpoints.json`` is the registry's HTTP read API.
+* **Stale-while-down cache** (opt-in, ``--stale-cache-ttl-s``) — the
+  last good answer per scope is kept for a bounded TTL and served with
+  an explicit ``X-PIO-Stale: true`` marker ONLY when no replica can
+  serve at all; a scope any live replica could answer is always served
+  fresh.
 
 Stdlib-only by contract (piolint manifest): replicas are opaque HTTP
 backends; the router must never import jax, storage, or the workflow.
@@ -68,7 +80,7 @@ import time
 import urllib.parse
 from typing import Any, Callable, Iterable, Mapping, Sequence
 
-from predictionio_tpu.fleet.registry import ModelRegistry
+from predictionio_tpu.fleet.registry import EndpointRegistry, ModelRegistry
 from predictionio_tpu.fleet.ring import HashRing
 from predictionio_tpu.resilience import CircuitBreaker
 from predictionio_tpu.serving.cache import affinity_key
@@ -124,6 +136,13 @@ class RouterConfig:
     reload_timeout_s: float = 300.0
     #: longest the rotation waits for a replica's in-flight requests
     drain_wait_s: float = 10.0
+    #: >0 enables the stale-while-down cache: the last good
+    #: ``/queries.json`` answer per scope is kept this many seconds and
+    #: served (marked ``X-PIO-Stale: true``) ONLY when no replica can
+    #: serve — never for a scope a live replica could answer fresh
+    stale_cache_ttl_s: float = 0.0
+    #: bounded entry count of the stale-while-down cache
+    stale_cache_entries: int = 1024
 
     def __post_init__(self) -> None:
         if self.probe_interval_s <= 0:
@@ -270,6 +289,9 @@ class _RouterStats:
         "reloads",
         "generation_regressions",
         "passthrough",
+        "membership_changes",
+        "lease_evictions",
+        "stale_served",
     )
 
     def __init__(self) -> None:
@@ -294,6 +316,9 @@ class _RouterStats:
                 "reloads": "reloads",
                 "generation_regressions": "generationRegressions",
                 "passthrough": "passthrough",
+                "membership_changes": "membershipChanges",
+                "lease_evictions": "leaseEvictions",
+                "stale_served": "staleServed",
             }
             return {camel[f]: getattr(self, f) for f in self._FIELDS}
 
@@ -340,9 +365,14 @@ class RouterService:
         config: RouterConfig | None = None,
         registry: ModelRegistry | None = None,
         split=None,
+        endpoint_registry: EndpointRegistry | None = None,
     ):
         self.config = config or RouterConfig()
         self.registry = registry
+        #: optional shared EndpointRegistry — when set, it is the single
+        #: source of truth for ring membership (reconciled each probe
+        #: interval); the ``replicas`` argument is only the initial view
+        self.endpoint_registry = endpoint_registry
         #: optional experiments.split.TrafficSplit — A/B assignment is a
         #: pure function of (salt, weights, affinity key), so stickiness
         #: survives router restarts and replica failover by construction
@@ -355,7 +385,17 @@ class RouterService:
         self._ring = HashRing(
             [r.id for r in self.replicas], vnodes=self.config.vnodes
         )
+        self._membership_lock = threading.Lock()
         self.stats = _RouterStats()
+        # stale-while-down: gen_key → (expires_monotonic, raw, headers)
+        self._stale_cache: "collections.OrderedDict[str, tuple]" = (
+            collections.OrderedDict()
+        )
+        self._stale_lock = threading.Lock()
+        # query arrival timestamps for the autoscaler's q/s window
+        self._query_times: "collections.deque[float]" = collections.deque(
+            maxlen=4096
+        )
         self.start_time = time.time()
         # bounded key→generation tags (the never-two-generations guard)
         self._key_gens: "collections.OrderedDict[str, int]" = (
@@ -442,9 +482,86 @@ class RouterService:
         for rep in self.replicas:
             self.probe_replica(rep)
 
+    def reconcile_endpoints(self) -> dict:
+        """Fold the shared endpoint registry into ring membership:
+        announced replicas join, withdrawn/expired ones leave. Expired
+        leases are evicted through the registry's rename-claim, so of N
+        routers sharing the directory exactly one counts each eviction
+        (``leaseEvictions``); every router counts its own local ring
+        rebuilds (``membershipChanges``). No-op without a registry."""
+        reg = self.endpoint_registry
+        if reg is None:
+            return {"joined": [], "left": [], "evicted": []}
+        evicted = reg.evict_expired()
+        if evicted:
+            self.stats.incr("lease_evictions", len(evicted))
+        live, _expired, problems = reg.snapshot()
+        with self._membership_lock:
+            current = self._by_id
+            live_by_id = {e.replica_id: e for e in live}
+            joined = [e for e in live if e.replica_id not in current]
+            left = [rid for rid in current if rid not in live_by_id]
+            # same id, new address = a respawned replica that re-bound
+            # port 0 — must be re-pointed, not just added/removed
+            moved = [
+                e.replica_id
+                for e in live
+                if e.replica_id in current
+                and (current[e.replica_id].host, current[e.replica_id].port)
+                != (e.host, e.port)
+            ]
+            if not joined and not left and not moved:
+                return {"joined": [], "left": [], "evicted": evicted,
+                        "problems": problems}
+            new_replicas: list[ReplicaState] = []
+            for entry in live:
+                rep = current.get(entry.replica_id)
+                if rep is None or (rep.host, rep.port) != (
+                    entry.host, entry.port
+                ):
+                    rep = ReplicaState(
+                        entry.replica_id, entry.host, entry.port, self.config
+                    )
+                    if entry.generation > 0:
+                        rep.generation = entry.generation
+                new_replicas.append(rep)
+            new_by_id = {r.id: r for r in new_replicas}
+            new_ring = HashRing(
+                sorted(new_by_id), vnodes=self.config.vnodes
+            )
+            leavers = [current[rid] for rid in left]
+            leavers += [current[rid] for rid in moved]  # stale-address pools
+            # readers capture these attributes per access and tolerate
+            # by_id/ring skew (missing members are dropped in selection),
+            # so plain assignment is the atomic publish
+            self._by_id = new_by_id
+            self._ring = new_ring
+            self.replicas = new_replicas
+            self.stats.incr(
+                "membership_changes", len(joined) + len(left) + len(moved)
+            )
+        for rep in leavers:
+            rep.pool.close_all()
+        if joined or left or moved:
+            logger.info(
+                "ring membership reconciled: +%s -%s ~%s (evicted %s)",
+                [e.replica_id for e in joined], left, moved, evicted,
+            )
+        return {
+            "joined": [e.replica_id for e in joined],
+            "left": left,
+            "moved": moved,
+            "evicted": evicted,
+            "problems": problems,
+        }
+
     def _monitor_loop(self) -> None:
         while not self._stop_event.is_set():
             t0 = time.monotonic()
+            try:
+                self.reconcile_endpoints()
+            except OSError as e:  # sharedfs hiccup: keep probing
+                logger.warning("endpoint reconcile failed: %s", e)
             self.probe_all()
             elapsed = time.monotonic() - t0
             self._stop_event.wait(
@@ -526,7 +643,14 @@ class RouterService:
         served-below-tag escape is counted, never a refused query)."""
         now = time.monotonic()
         if key is not None:
-            order = [self._by_id[m] for m in self._ring.sequence(key)]
+            ring, by_id = self._ring, self._by_id
+            # a reconcile may land between the two attribute reads: a
+            # ring member missing from by_id is simply dropped this pass
+            order = [
+                r
+                for r in (by_id.get(m) for m in ring.sequence(key))
+                if r is not None
+            ]
         else:
             order = sorted(
                 self.replicas, key=lambda r: (r.inflight, r.forwarded)
@@ -569,6 +693,7 @@ class RouterService:
     def _record_latency(self, seconds: float) -> None:
         with self._latencies_lock:
             self._latencies.append(seconds)
+            self._query_times.append(time.monotonic())
 
     def _p95_s(self) -> float:
         with self._latencies_lock:
@@ -581,6 +706,51 @@ class RouterService:
         # p95-triggered with the configured floor: a cold histogram (or a
         # uniformly fast one) never hedges earlier than hedge_ms
         return max(self.config.hedge_ms / 1000.0, self._p95_s())
+
+    def load_snapshot(self, window_s: float = 5.0) -> dict:
+        """Router-side load over the trailing window — the autoscaler's
+        watermark inputs: queries/second and p99 latency."""
+        now = time.monotonic()
+        with self._latencies_lock:
+            recent = sum(1 for t in self._query_times if now - t <= window_s)
+            lat = sorted(self._latencies)
+        p99 = lat[min(len(lat) - 1, int(0.99 * len(lat)))] if lat else 0.0
+        return {
+            "windowSeconds": window_s,
+            "qps": recent / window_s if window_s > 0 else 0.0,
+            "p99Seconds": p99,
+            "replicas": len(self.replicas),
+        }
+
+    # ----------------------------------------------------- stale-while-down
+    def _stale_put(self, gen_key: str | None, raw: bytes, headers: dict) -> None:
+        if gen_key is None or self.config.stale_cache_ttl_s <= 0:
+            return
+        expires = time.monotonic() + self.config.stale_cache_ttl_s
+        with self._stale_lock:
+            self._stale_cache[gen_key] = (expires, raw, dict(headers))
+            self._stale_cache.move_to_end(gen_key)
+            while len(self._stale_cache) > self.config.stale_cache_entries:
+                self._stale_cache.popitem(last=False)
+
+    def _stale_response(self, gen_key: str | None) -> _Wire | None:
+        """The bounded-TTL last-good answer for this scope, explicitly
+        marked ``X-PIO-Stale`` — called ONLY from the no-candidate-can-
+        serve paths, so a fresh-capable scope never sees it."""
+        if gen_key is None or self.config.stale_cache_ttl_s <= 0:
+            return None
+        with self._stale_lock:
+            entry = self._stale_cache.get(gen_key)
+            if entry is None:
+                return None
+            expires, raw, headers = entry
+            if time.monotonic() >= expires:
+                del self._stale_cache[gen_key]
+                return None
+        self.stats.incr("stale_served")
+        out = dict(headers)
+        out["X-PIO-Stale"] = "true"
+        return _Wire(200, raw=raw, headers=out)
 
     def _forward_query(
         self,
@@ -682,6 +852,9 @@ class RouterService:
         min_gen = self._key_gen_get(gen_key)
         candidates = self._candidates(key, min_gen)
         if not candidates:
+            stale = self._stale_response(gen_key)
+            if stale is not None:
+                return stale
             return self._all_down_response()
         failovers = 0
         last_503: _Wire | None = None
@@ -789,11 +962,18 @@ class RouterService:
             out_headers["X-PIO-Routed-Replica"] = rep.id
             if variant is not None:
                 out_headers["X-PIO-Variant"] = variant
+            if status == 200:
+                self._stale_put(gen_key, raw, out_headers)
             return _Wire(status, raw=raw, headers=out_headers)
         if last_503 is not None:
             # every peer was also draining/down: the drain 503 (with its
             # Retry-After) is the truthful answer
             return last_503
+        # every candidate was tried and is down: the last good answer
+        # (explicitly marked stale) beats a 503 for a read-shaped query
+        stale = self._stale_response(gen_key)
+        if stale is not None:
+            return stale
         return self._all_down_response()
 
     # ------------------------------------------------------------ broadcast
@@ -1068,6 +1248,34 @@ class RouterService:
             out["replicaStats"] = details
         return out
 
+    def endpoints_json(self) -> dict:
+        """``GET /fleet/endpoints.json``: the registry's HTTP read API —
+        live entries (with lease ages), expired-but-unevicted entries,
+        torn-file problems, and this router's current ring view."""
+        now = time.time()
+        reg = self.endpoint_registry
+        doc: dict[str, Any] = {
+            "registry": None,
+            "ring": sorted(self._by_id),
+            "replicas": [r.to_json() for r in self.replicas],
+            "membershipChanges": self.stats.membership_changes,
+            "leaseEvictions": self.stats.lease_evictions,
+        }
+        if reg is None:
+            return doc
+        live, expired, problems = reg.snapshot(now)
+        doc["registry"] = {
+            "directory": reg.directory,
+            "leaseTtlSeconds": reg.lease_ttl_s,
+            "live": [
+                dict(e.to_json(), leaseAgeSeconds=round(e.lease_age_s(now), 3))
+                for e in live
+            ],
+            "expired": [e.to_json() for e in expired],
+            "problems": problems,
+        }
+        return doc
+
     def readiness(self) -> dict:
         """Router /readyz: ready while at least one replica can serve."""
         now = time.monotonic()
@@ -1199,6 +1407,8 @@ class RouterService:
             return _Wire(
                 200, self.stats_json(fanout=params.get("fanout") == "1")
             )
+        if path == "/fleet/endpoints.json" and method == "GET":
+            return _Wire(200, self.endpoints_json())
         if path == "/reload" and method == "POST":
             status, report = self.rolling_reload()
             return _Wire(status, report)

@@ -95,6 +95,8 @@ class FleetSupervisor:
         self.poll_interval_s = poll_interval_s
         self._lock = threading.Lock()
         self._procs: dict[str, subprocess.Popen] = {}
+        #: retired replicas still draining — stop() escalates on them too
+        self._retiring: list[subprocess.Popen] = []
         self._respawn_times: dict[str, list[float]] = {}
         self._failed: set[str] = set()
         self._stopping = threading.Event()
@@ -129,7 +131,9 @@ class FleetSupervisor:
     def _monitor_loop(self) -> None:
         while not self._stopping.wait(self.poll_interval_s):
             changed = False
-            for spec in self.specs:
+            with self._lock:
+                specs = list(self.specs)  # autoscaler mutates the fleet
+            for spec in specs:
                 with self._lock:
                     proc = self._procs.get(spec.replica_id)
                     failed = spec.replica_id in self._failed
@@ -185,6 +189,54 @@ class FleetSupervisor:
             if changed and not self._stopping.is_set():
                 self.write_state()
 
+    # ------------------------------------------------------------- elastic
+    def add_replica(self, spec: ReplicaSpec) -> None:
+        """Scale-up: spawn one more replica and start watching it."""
+        if self._stopping.is_set():
+            return
+        proc = self._spawn(spec)  # outside the lock (Popen blocks)
+        with self._lock:
+            if self._stopping.is_set():
+                proc.terminate()
+                return
+            self.specs.append(spec)
+            self._procs[spec.replica_id] = proc
+        self.write_state()
+
+    def retire_replica(self, replica_id: str) -> bool:
+        """Scale-down, drain-aware: remove the spec FIRST (so the monitor
+        never respawns it), then SIGTERM — the replica drains in-flight
+        queries per its ``--drain-deadline-s`` and withdraws its own
+        registry entry on clean exit. Returns whether a replica was
+        actually retired."""
+        with self._lock:
+            spec = next(
+                (s for s in self.specs if s.replica_id == replica_id), None
+            )
+            if spec is None:
+                return False
+            self.specs.remove(spec)
+            proc = self._procs.pop(replica_id, None)
+            if proc is not None:
+                self._retiring.append(proc)
+            self._failed.discard(replica_id)
+            self._respawn_times.pop(replica_id, None)
+        if proc is not None and proc.poll() is None:
+            try:
+                proc.send_signal(signal.SIGTERM)
+            except OSError:
+                pass
+        logger.info("retiring replica %s (drain via SIGTERM)", replica_id)
+        self.write_state()
+        return True
+
+    def retiring_count(self) -> int:
+        """Retired replicas still draining (their process has not exited
+        yet) — the autoscaler holds further scale-downs while > 0."""
+        with self._lock:
+            self._retiring = [p for p in self._retiring if p.poll() is None]
+            return len(self._retiring)
+
     # --------------------------------------------------------------- state
     def state(self) -> dict:
         with self._lock:
@@ -231,7 +283,7 @@ class FleetSupervisor:
             return
         self._stopping.set()
         with self._lock:
-            procs = list(self._procs.values())
+            procs = list(self._procs.values()) + list(self._retiring)
         for proc in procs:
             if proc.poll() is None:
                 try:

@@ -59,7 +59,9 @@ from predictionio_tpu.resilience.faults import FaultError, FaultInjector
 __all__ = [
     "ChaosConfig",
     "ChaosError",
+    "FleetChaosConfig",
     "ServeChaosConfig",
+    "run_chaos_fleet",
     "run_chaos_ingest",
     "run_chaos_serve",
 ]
@@ -1569,5 +1571,597 @@ def run_chaos_serve(cfg: ServeChaosConfig) -> dict:
                 and report.get("shardedReplica", {}).get("qps", 0) > 0
             )
         )
+    )
+    return report
+
+
+# ---------------------------------------------------------------------------
+# Cross-host elastic-fleet chaos (``pio chaos-fleet``; ISSUE 17)
+# ---------------------------------------------------------------------------
+#
+# ``pio chaos-serve`` kills one replica behind one router; this drill
+# kills a whole "host". Two independent ``pio deploy --replicas N``
+# trees on SEPARATE storage basedirs (two hosts in miniature — separate
+# supervisors, separate routers) share one endpoint-registry directory,
+# so both routers see one 2N-replica consistent-hash ring. Then:
+#
+# 1. **host-kill** — SIGKILL host A's entire tree (every replica AND its
+#    router/supervisor) under concurrent clients that never retry a
+#    delivered answer but DO fail over between routers on transport
+#    errors (the dead router never answered — the idempotent-read retry
+#    is the client-visible router-HA contract). Verdict: zero failed
+#    queries; the surviving router routes around the dead replicas
+#    within its probe interval and evicts them on lease expiry; the
+#    killed host, restarted, rejoins the ring through the registry with
+#    no operator re-wiring.
+# 2. **autoscale** — a 1-replica fleet with ``--autoscale 1:2`` under
+#    watermark-crossing load must scale up (new replica binds port 0,
+#    self-reports, joins the ring); when the load drops to a trickle it
+#    must retire the extra replica drain-aware — the trickle (and the
+#    full load before it) loses zero queries.
+# 3. **stale-while-down** — a 1-replica fleet with
+#    ``--stale-cache-ttl-s``: after its replica is SIGKILLed, a
+#    previously-answered scope is served from the router's stale cache
+#    (200 + ``X-PIO-Stale: true``), an unknown scope still gets a clean
+#    503, and after respawn the scope is fresh again with no marker.
+#    While any owner is alive the marker must never appear.
+#
+# Same contract as the other drills: stdlib-only, real subprocesses,
+# verdicts as asserted fields. Feeds the bench ``fleet_elastic`` section
+# and its smoke guard.
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetChaosConfig:
+    """Knobs of one elastic-fleet chaos run (CLI: ``pio chaos-fleet``)."""
+
+    replicas_per_host: int = 1
+    clients: int = 16
+    phase_seconds: float = 6.0
+    #: synthetic `rate` events the tiny model trains on
+    train_events: int = 400
+    train_users: int = 60
+    train_items: int = 120
+    rank: int = 8
+    iterations: int = 2
+    #: endpoint-registry lease TTL for the host-kill phase — the
+    #: eviction clock the surviving router runs on
+    lease_ttl_s: float = 1.0
+    seed: int = 0
+    autoscale_phase: bool = True
+    stale_phase: bool = True
+    probe_interval_s: float = 0.25
+    breaker_reset_s: float = 1.0
+    query_timeout_s: float = 20.0
+    startup_timeout_s: float = 180.0
+    total_timeout_s: float = 900.0
+    base_dir: str | None = None
+    keep_dir: bool = False
+
+    def __post_init__(self) -> None:
+        if self.replicas_per_host < 1 or self.clients < 1:
+            raise ValueError("replicas_per_host and clients must be >= 1")
+        if self.lease_ttl_s <= 0:
+            raise ValueError("lease_ttl_s must be > 0")
+
+
+class _HAQueryClients(_QueryClients):
+    """Query clients with client-visible router failover: a transport
+    error from one router (connection refused/reset — the router died
+    before DELIVERING an answer) is retried once on the other router;
+    an HTTP error from a live router is a failed query and is never
+    retried. ``router_failovers`` counts recovered failovers;
+    ``transport_errors`` keeps its parent meaning of an UNRECOVERED
+    request (every router transport-failed) — still a failure."""
+
+    def __init__(self, ports: list[int], cfg):
+        super().__init__(ports[0], cfg)
+        self.ports = list(ports)
+        self.router_failovers = 0
+        self._preferred = 0  # advisory: index of the last router that answered
+
+    def _run(self, cid: int) -> None:
+        cfg = self.cfg
+        users = [
+            f"u{u}" for u in range(cfg.train_users) if u % cfg.clients == cid
+        ] or [f"u{cid % cfg.train_users}"]
+        rng = random.Random(cfg.seed * 7919 + cid)
+        while not self.stop.is_set():
+            user = users[rng.randrange(len(users))]
+            payload = json.dumps({"user": user, "num": 4}).encode()
+            t0 = time.monotonic()
+            status = 0
+            generation = 0
+            answered = False
+            preferred = self._preferred
+            order = [
+                self.ports[(preferred + k) % len(self.ports)]
+                for k in range(len(self.ports))
+            ]
+            for attempt, port in enumerate(order):
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{port}/queries.json",
+                    data=payload,
+                    headers={"Content-Type": "application/json"},
+                    method="POST",
+                )
+                try:
+                    with urllib.request.urlopen(
+                        req, timeout=cfg.query_timeout_s
+                    ) as resp:
+                        resp.read()
+                        status = resp.status
+                        generation = int(
+                            resp.headers.get("X-PIO-Generation", "0") or 0
+                        )
+                except urllib.error.HTTPError as e:
+                    e.read()
+                    status = e.code
+                except Exception:
+                    if attempt + 1 < len(order):
+                        with self._lock:
+                            self.router_failovers += 1
+                    continue
+                answered = True
+                with self._lock:
+                    self._preferred = self.ports.index(port)
+                break
+            if not answered:
+                with self._lock:
+                    self.transport_errors += 1
+                time.sleep(0.05)
+                continue
+            t1 = time.monotonic()
+            with self._lock:
+                self.samples.append((t1, t1 - t0, status, user, generation))
+
+
+def _elastic_host(base: str, seed_dir: str, name: str) -> tuple[str, dict]:
+    """Clone the trained seed storage into a fresh per-"host" basedir —
+    two hosts with independent supervisors/state files, one shared model
+    lineage (the shared-filesystem deployment the registry targets)."""
+    host_dir = os.path.join(base, name)
+    shutil.copytree(seed_dir, host_dir)
+    return host_dir, _storage_env(host_dir, "sqlite")
+
+
+def _elastic_fleet(
+    env: dict,
+    host_dir: str,
+    engine_json: str,
+    reg_dir: str,
+    cfg: FleetChaosConfig,
+    replicas: int,
+    extra_args: tuple[str, ...] = (),
+) -> _FleetProc:
+    return _FleetProc(
+        env, host_dir, engine_json, replicas, cfg,
+        extra_args=(
+            "--endpoint-registry", reg_dir,
+            "--lease-ttl-s", str(cfg.lease_ttl_s),
+            "--drain-deadline-s", "5",
+            *extra_args,
+        ),
+    )
+
+
+def _wait_fleet_view(
+    fleet: _FleetProc, expect: int, timeout_s: float, what: str
+) -> float:
+    """Until the router's ring holds EXACTLY ``expect`` healthy replicas
+    (registry-joined fleets start with an empty ring and grow as
+    replicas self-report); returns seconds waited."""
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout_s:
+        if fleet.proc.poll() is not None:
+            raise ChaosError(
+                f"{what}: fleet exited rc={fleet.proc.returncode} before ready"
+            )
+        status = fleet.status()
+        if status is not None:
+            reps = status.get("replicas", [])
+            if len(reps) == expect and all(r.get("healthy") for r in reps):
+                return time.monotonic() - t0
+        time.sleep(0.1)
+    raise ChaosError(f"{what}: ring never reached {expect} healthy replicas")
+
+
+def _host_kill_phase(
+    base: str, seed_dir: str, engine_json: str, cfg: FleetChaosConfig
+) -> dict:
+    """SIGKILL one entire host's tree under HA clients; the surviving
+    router absorbs, the restarted host rejoins through the registry."""
+    reg_dir = os.path.join(base, "endpoints-hostkill")
+    host_a, env_a = _elastic_host(base, seed_dir, "hostA")
+    host_b, env_b = _elastic_host(base, seed_dir, "hostB")
+    expect = 2 * cfg.replicas_per_host
+    fleet_a = _elastic_fleet(env_a, host_a, engine_json, reg_dir, cfg,
+                             cfg.replicas_per_host)
+    fleet_b: _FleetProc | None = None
+    fleet_a2: _FleetProc | None = None
+    clients: _HAQueryClients | None = None
+    try:
+        fleet_b = _elastic_fleet(env_b, host_b, engine_json, reg_dir, cfg,
+                                 cfg.replicas_per_host)
+        ready_s = max(
+            _wait_fleet_view(fleet_a, expect, cfg.startup_timeout_s, "hostA"),
+            _wait_fleet_view(fleet_b, expect, cfg.startup_timeout_s, "hostB"),
+        )
+        _warm_fleet(fleet_a.port, cfg)
+        _warm_fleet(fleet_b.port, cfg)
+        clients = _HAQueryClients([fleet_a.port, fleet_b.port], cfg)
+        clients.start()
+        t0 = time.monotonic()
+        time.sleep(max(0.5, cfg.phase_seconds * 0.25))
+
+        # ---- SIGKILL every process of host A: replicas first, then the
+        # router/supervisor itself — the whole host goes dark at once
+        t_kill = time.monotonic()
+        pids = [
+            int(r["pid"])
+            for r in (fleet_a.state() or {}).get("replicas", [])
+            if r.get("pid")
+        ]
+        for pid in pids:
+            try:
+                os.kill(pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+        fleet_a.proc.send_signal(signal.SIGKILL)
+        fleet_a.proc.wait(timeout=30)
+
+        # ---- surviving router: routed-around (unhealthy or gone) fast,
+        # evicted from the ring on lease expiry
+        absorb_s = None
+        evict_s = None
+        absorb_deadline = (
+            t_kill + cfg.lease_ttl_s + 10 * cfg.probe_interval_s + 10.0
+        )
+        while time.monotonic() < absorb_deadline:
+            status = fleet_b.status() or {}
+            reps = status.get("replicas", [])
+            dead_visible = [
+                r for r in reps if not r.get("healthy")
+            ]
+            if absorb_s is None and len(reps) - len(dead_visible) == (
+                cfg.replicas_per_host
+            ):
+                absorb_s = time.monotonic() - t_kill
+            if len(reps) == cfg.replicas_per_host:
+                evict_s = time.monotonic() - t_kill
+                if absorb_s is None:  # evicted before a poll saw "unhealthy"
+                    absorb_s = evict_s
+                break
+            time.sleep(0.05)
+
+        time.sleep(max(1.0, cfg.phase_seconds * 0.25))
+
+        # ---- restart host A: same basedir, same registry — it must
+        # rejoin the ring with no re-wiring
+        fleet_a2 = _elastic_fleet(env_a, host_a, engine_json, reg_dir, cfg,
+                                  cfg.replicas_per_host)
+        t_restart = time.monotonic()
+        rejoin_s = None
+        rejoin_deadline = t_restart + cfg.startup_timeout_s
+        while time.monotonic() < rejoin_deadline:
+            status = fleet_b.status() or {}
+            reps = status.get("replicas", [])
+            if len(reps) == expect and all(r.get("healthy") for r in reps):
+                rejoin_s = time.monotonic() - t_restart
+                break
+            time.sleep(0.1)
+        time.sleep(max(1.0, cfg.phase_seconds * 0.25))
+        t_end = time.monotonic()
+        clients.join()
+        overall = clients.summarize(t0, t_end)
+        failed = overall["failed"] + overall["transportErrors"]
+        return {
+            "replicasPerHost": cfg.replicas_per_host,
+            "readySeconds": round(ready_s, 2),
+            "killedPids": len(pids) + 1,  # replicas + the router tree
+            "overall": overall,
+            "routerFailovers": clients.router_failovers,
+            "absorbSeconds": round(absorb_s, 3) if absorb_s is not None else None,
+            "evictSeconds": round(evict_s, 3) if evict_s is not None else None,
+            "rejoinSeconds": round(rejoin_s, 3) if rejoin_s is not None else None,
+            "failedQueries": failed,
+            "ok": bool(
+                failed == 0
+                and overall["requests"] > 0
+                and absorb_s is not None
+                and evict_s is not None
+                and rejoin_s is not None
+            ),
+        }
+    finally:
+        if clients is not None:
+            clients.stop.set()
+        for f in (fleet_a, fleet_a2, fleet_b):
+            if f is not None:
+                f.stop()
+
+
+def _autoscale_phase(
+    base: str, seed_dir: str, engine_json: str, cfg: FleetChaosConfig
+) -> dict:
+    """Watermark scale-up under load, then drain-aware retirement under
+    a trickle — zero queries lost across both transitions."""
+    reg_dir = os.path.join(base, "endpoints-autoscale")
+    host_dir, env = _elastic_host(base, seed_dir, "hostScale")
+    # watermarks sized to the drill: 16 concurrent clients blow far past
+    # 8 q/s per replica; the 1 q/s trickle sits far below 2 q/s per
+    # replica once the trailing window drains
+    fleet = _elastic_fleet(
+        env, host_dir, engine_json, reg_dir, cfg, 1,
+        extra_args=(
+            "--autoscale", "1:2",
+            "--scale-up-qps", "8",
+            "--scale-down-qps", "2",
+            "--scale-cooldown-s", "1",
+        ),
+    )
+    clients: _QueryClients | None = None
+    trickle_stop = threading.Event()
+    trickle = {"requests": 0, "failed": 0, "statuses": []}
+    trickle_lock = threading.Lock()
+
+    def trickle_client() -> None:
+        i = 0
+        while not trickle_stop.is_set():
+            i += 1
+            payload = json.dumps(
+                {"user": f"u{i % cfg.train_users}", "num": 4}
+            ).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{fleet.port}/queries.json",
+                data=payload,
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            status = 0
+            try:
+                with urllib.request.urlopen(
+                    req, timeout=cfg.query_timeout_s
+                ) as resp:
+                    resp.read()
+                    status = resp.status
+            except urllib.error.HTTPError as e:
+                e.read()
+                status = e.code
+            except Exception:
+                status = 0
+            with trickle_lock:
+                trickle["requests"] += 1
+                if not 200 <= status < 300:
+                    trickle["failed"] += 1
+                    trickle["statuses"].append(status)
+            trickle_stop.wait(1.0)
+
+    try:
+        _wait_fleet_view(fleet, 1, cfg.startup_timeout_s, "autoscale")
+        _warm_fleet(fleet.port, cfg)
+        clients = _QueryClients(fleet.port, cfg)
+        clients.start()
+        t0 = time.monotonic()
+
+        # ---- scale-up: the ring must grow to 2 healthy replicas (cold
+        # replica start pays the model load, hence the startup budget)
+        scale_up_s = None
+        deadline = t0 + cfg.startup_timeout_s
+        while time.monotonic() < deadline:
+            status = fleet.status() or {}
+            reps = status.get("replicas", [])
+            if len(reps) == 2 and all(r.get("healthy") for r in reps):
+                scale_up_s = time.monotonic() - t0
+                break
+            time.sleep(0.1)
+        t_load_end = time.monotonic()
+        clients.join()
+        load_summary = clients.summarize(t0, t_load_end)
+
+        # ---- scale-down: drop to a trickle; the autoscaler must retire
+        # one replica drain-aware (its registry entry withdrawn on clean
+        # exit) without losing a single trickle query
+        trickle_thread = threading.Thread(
+            target=trickle_client, name="chaos-trickle", daemon=True
+        )
+        trickle_thread.start()
+        scale_down_s = None
+        if scale_up_s is not None:
+            t1 = time.monotonic()
+            deadline = t1 + cfg.startup_timeout_s
+            while time.monotonic() < deadline:
+                status = fleet.status() or {}
+                reps = status.get("replicas", [])
+                if len(reps) == 1 and all(r.get("healthy") for r in reps):
+                    scale_down_s = time.monotonic() - t1
+                    break
+                time.sleep(0.1)
+        # a couple more trickle beats AFTER the retirement settles —
+        # the survivor must be serving alone
+        trickle_stop.wait(2.0)
+        trickle_stop.set()
+        trickle_thread.join(timeout=10)
+        with trickle_lock:
+            trickle_out = dict(trickle)
+        failed = (
+            load_summary["failed"]
+            + load_summary["transportErrors"]
+            + trickle_out["failed"]
+        )
+        return {
+            "scaleUpSeconds": round(scale_up_s, 2)
+            if scale_up_s is not None
+            else None,
+            "scaleDownSeconds": round(scale_down_s, 2)
+            if scale_down_s is not None
+            else None,
+            "loadWindow": load_summary,
+            "trickle": {
+                "requests": trickle_out["requests"],
+                "failed": trickle_out["failed"],
+                "failedStatuses": sorted(set(trickle_out["statuses"])),
+            },
+            "failedQueries": failed,
+            "ok": bool(
+                scale_up_s is not None
+                and scale_down_s is not None
+                and failed == 0
+                and load_summary["requests"] > 0
+                and trickle_out["requests"] > 0
+            ),
+        }
+    finally:
+        trickle_stop.set()
+        if clients is not None:
+            clients.stop.set()
+        fleet.stop()
+
+
+def _query_once(
+    port: int, payload: bytes, timeout_s: float
+) -> tuple[int, dict]:
+    """One never-retried query; returns (status, lowercased headers)."""
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/queries.json",
+        data=payload,
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+            resp.read()
+            return resp.status, {k.lower(): v for k, v in resp.headers.items()}
+    except urllib.error.HTTPError as e:
+        e.read()
+        return e.code, {k.lower(): v for k, v in e.headers.items()}
+
+
+def _stale_phase(
+    base: str, seed_dir: str, engine_json: str, cfg: FleetChaosConfig
+) -> dict:
+    """Stale-while-down: with every owner replica dead, a cached scope
+    is served marked-stale, an uncached scope is a clean 503, and a
+    healthy fleet never emits the marker."""
+    reg_dir = os.path.join(base, "endpoints-stale")
+    host_dir, env = _elastic_host(base, seed_dir, "hostStale")
+    stale_cfg = dataclasses.replace(cfg, lease_ttl_s=10.0)  # outlive the outage
+    fleet = _elastic_fleet(
+        env, host_dir, engine_json, reg_dir, stale_cfg, 1,
+        extra_args=("--stale-cache-ttl-s", "60"),
+    )
+    cached = json.dumps({"user": "u0", "num": 4}).encode()
+    uncached = json.dumps({"user": "u59", "num": 4}).encode()
+    report: dict[str, Any] = {}
+    try:
+        _wait_fleet_view(fleet, 1, cfg.startup_timeout_s, "stale")
+        _warm_fleet(fleet.port, cfg, distinct_users=1)  # warms u0
+        fresh_status, fresh_headers = _query_once(
+            fleet.port, cached, cfg.query_timeout_s
+        )
+        report["freshStatus"] = fresh_status
+        report["freshMarked"] = "x-pio-stale" in fresh_headers
+
+        state = fleet.state() or {}
+        rep = (state.get("replicas") or [{}])[0]
+        rid, pid = str(rep.get("id")), int(rep.get("pid") or 0)
+        if not pid:
+            raise ChaosError("stale phase: no replica pid on file")
+        os.kill(pid, signal.SIGKILL)
+        try:
+            stale_status, stale_headers = _query_once(
+                fleet.port, cached, cfg.query_timeout_s
+            )
+        except OSError as e:
+            stale_status, stale_headers = 0, {"error": str(e)}
+        report["staleStatus"] = stale_status
+        report["staleMarked"] = stale_headers.get("x-pio-stale") == "true"
+        try:
+            uncached_status, uncached_headers = _query_once(
+                fleet.port, uncached, cfg.query_timeout_s
+            )
+        except OSError:
+            uncached_status, uncached_headers = 0, {}
+        report["uncachedStatus"] = uncached_status
+        report["uncachedMarked"] = "x-pio-stale" in uncached_headers
+
+        respawned = fleet.wait_respawn(rid, pid, cfg.startup_timeout_s)
+        report["respawned"] = respawned
+        after_status, after_marked = 0, True
+        deadline = time.monotonic() + cfg.startup_timeout_s
+        while time.monotonic() < deadline:
+            try:
+                after_status, after_headers = _query_once(
+                    fleet.port, cached, cfg.query_timeout_s
+                )
+            except OSError:
+                time.sleep(0.2)
+                continue
+            after_marked = "x-pio-stale" in after_headers
+            if after_status == 200 and not after_marked:
+                break
+            time.sleep(0.2)
+        report["freshAfterStatus"] = after_status
+        report["freshAfterMarked"] = after_marked
+    finally:
+        fleet.stop()
+    report["ok"] = bool(
+        report.get("freshStatus") == 200
+        and not report.get("freshMarked")
+        and report.get("staleStatus") == 200
+        and report.get("staleMarked")
+        and report.get("uncachedStatus") == 503
+        and not report.get("uncachedMarked")
+        and report.get("respawned")
+        and report.get("freshAfterStatus") == 200
+        and not report.get("freshAfterMarked")
+    )
+    return report
+
+
+def run_chaos_fleet(cfg: FleetChaosConfig) -> dict:
+    """Run the full elastic-fleet drill; returns the report dict
+    (``report["ok"]`` is the overall verdict — the CLI exit code and the
+    bench ``fleet_elastic`` smoke guard key off the individual fields)."""
+    base = cfg.base_dir or tempfile.mkdtemp(prefix="pio_chaos_fleet_")
+    os.makedirs(base, exist_ok=True)
+    seed_dir = os.path.join(base, "seed")
+    os.makedirs(seed_dir, exist_ok=True)
+    env = _storage_env(seed_dir, "sqlite")
+    report: dict[str, Any] = {
+        "replicasPerHost": cfg.replicas_per_host,
+        "clients": cfg.clients,
+        "leaseTtlSeconds": cfg.lease_ttl_s,
+        "seed": cfg.seed,
+        "cpuCount": os.cpu_count(),
+    }
+    t_start = time.monotonic()
+    try:
+        t0 = time.monotonic()
+        engine_json = _serve_setup(env, seed_dir, cfg)
+        report["setupSeconds"] = round(time.monotonic() - t0, 1)
+        report["hostKill"] = _host_kill_phase(base, seed_dir, engine_json, cfg)
+        if cfg.autoscale_phase:
+            report["autoscale"] = _autoscale_phase(
+                base, seed_dir, engine_json, cfg
+            )
+        if cfg.stale_phase:
+            report["staleWhileDown"] = _stale_phase(
+                base, seed_dir, engine_json, cfg
+            )
+        report["totalSeconds"] = round(time.monotonic() - t_start, 1)
+    except (ChaosError, subprocess.TimeoutExpired) as e:
+        report["error"] = str(e)[:800]
+        report["ok"] = False
+        return report
+    finally:
+        if not cfg.keep_dir and cfg.base_dir is None:
+            shutil.rmtree(base, ignore_errors=True)
+        else:
+            report["storageDir"] = base
+    report["ok"] = bool(
+        report.get("hostKill", {}).get("ok")
+        and (not cfg.autoscale_phase or report.get("autoscale", {}).get("ok"))
+        and (not cfg.stale_phase or report.get("staleWhileDown", {}).get("ok"))
     )
     return report

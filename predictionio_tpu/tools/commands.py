@@ -447,32 +447,55 @@ def status_check(out: Out = _print) -> dict:
 
 def fleet_status(out: Out = _print) -> list[dict]:
     """Aggregate every active replica fleet on this host (``pio deploy
-    --replicas``; ISSUE 15): read the supervisor's state files under the
-    deployments dir, probe each replica's ``/readyz``, and report
-    per-replica readiness + model generation plus whether the fleet has
-    converged to ONE generation — the operator's rollout gate."""
+    --replicas``; ISSUE 15/17): the cross-host endpoint registry is the
+    primary view (per-host replica rows with lease age, generation and
+    readiness, ring membership, stale-lease and torn-entry warnings);
+    the supervisor's per-host state files are the degraded fallback —
+    they still list PIDs and liveness when the registry dir is absent
+    (pre-elastic fleets) or unreadable."""
     import glob
     import urllib.request
 
     pattern = os.path.join(Storage.base_dir(), "deployments", "fleet-*.json")
     paths = sorted(glob.glob(pattern))
-    if not paths:
-        return []  # no fleet on this host: never even import the package
+    registry_dir = os.path.join(Storage.base_dir(), "fleet", "endpoints")
+    if not paths and not os.path.isdir(registry_dir):
+        return []  # nothing fleet-ish on this host: never import the package
     from predictionio_tpu.fleet.supervisor import read_fleet_state
 
     fleets: list[dict] = []
-    for path in paths:
-        state = read_fleet_state(path)
-        if state is None:
-            continue
+    states = [s for s in (read_fleet_state(p) for p in paths) if s]
+    # a fleet on a custom --endpoint-registry DIR reports its directory
+    # on the router's /fleet/endpoints.json — ask each router so status
+    # aggregates THAT registry, not just the default location
+    registry_dirs: list[str] = []
+    for state in states:
+        reported = _router_registry_dir(state.get("routerPort"))
+        if reported and reported not in registry_dirs:
+            registry_dirs.append(reported)
+    if os.path.isdir(registry_dir) and registry_dir not in registry_dirs:
+        registry_dirs.append(registry_dir)
+    for directory in registry_dirs:
+        registry_view = _endpoint_registry_status(directory, out)
+        if registry_view is not None:
+            fleets.append({"endpointRegistry": registry_view})
+    for state in states:
         replicas = []
         for rep in state.get("replicas", []):
             entry = {
                 "id": rep.get("id"),
-                "port": rep.get("port"),
+                "port": rep.get("port") or None,
                 "ready": False,
                 "generation": None,
+                "alive": rep.get("alive"),
             }
+            if not entry["port"]:
+                # elastic replica: bound port 0 and self-reported through
+                # the registry — the registry view above is authoritative;
+                # this row only carries supervisor liveness
+                entry["ready"] = None
+                replicas.append(entry)
+                continue
             try:
                 with urllib.request.urlopen(
                     f"http://127.0.0.1:{rep.get('port')}/readyz", timeout=2
@@ -496,12 +519,21 @@ def fleet_status(out: Out = _print) -> list[dict]:
         if experiment is not None:
             fleet["experiment"] = experiment
         fleets.append(fleet)
+        probed = [r for r in replicas if r["ready"] is not None]
+        if probed:
+            ready_part = (
+                f"{sum(1 for r in probed if r['ready'])}/{len(probed)} "
+                f"replicas ready, generations "
+                f"{sorted(generations) if generations else '[]'}"
+                f"{' (converged)' if fleet['generationConverged'] else ''}"
+            )
+        else:
+            ready_part = (
+                f"{len(replicas)} replica(s), readiness via the endpoint "
+                "registry above"
+            )
         out(
-            f"  fleet      router :{fleet['routerPort']} — "
-            f"{sum(1 for r in replicas if r['ready'])}/{len(replicas)} "
-            f"replicas ready, generations "
-            f"{sorted(generations) if generations else '[]'}"
-            f"{' (converged)' if fleet['generationConverged'] else ''}"
+            f"  fleet      router :{fleet['routerPort']} — {ready_part}"
         )
         if experiment is not None:
             arms = ", ".join(
@@ -520,6 +552,100 @@ def fleet_status(out: Out = _print) -> list[dict]:
                 )
             )
     return fleets
+
+
+def _router_registry_dir(router_port: int | None) -> str | None:
+    """The registry directory a live router actually serves from
+    (``GET /fleet/endpoints.json``) — how status finds a custom
+    ``--endpoint-registry DIR``. ``None`` when the router is down or
+    pre-elastic (404)."""
+    import urllib.request
+
+    if not router_port:
+        return None
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{router_port}/fleet/endpoints.json", timeout=2
+        ) as resp:
+            doc = json.loads(resp.read())
+        return doc.get("registry", {}).get("directory") or None
+    except Exception:
+        return None
+
+
+def _endpoint_registry_status(directory: str, out: Out = _print) -> dict | None:
+    """Aggregate the cross-host endpoint registry for ``pio status``
+    (ISSUE 17): per-host replica rows (lease age, generation, readiness
+    probed at the self-reported address), ring membership, stale-lease
+    warnings for expired-but-unevicted entries, and loud torn-entry
+    problems. ``None`` when the registry dir is absent — callers fall
+    back to the per-host supervisor state files."""
+    import urllib.request
+
+    if not os.path.isdir(directory):
+        return None
+    from predictionio_tpu.fleet.registry import EndpointRegistry
+
+    # read-only aggregation: snapshot, never evict — eviction is the
+    # routers' job (claimed exactly once); status just reports
+    live, expired, problems = EndpointRegistry(directory).snapshot()
+    hosts: dict[str, list[dict]] = {}
+    for entry in live:
+        row = {
+            "id": entry.replica_id,
+            "host": entry.host,
+            "port": entry.port,
+            "leaseAgeS": round(entry.lease_age_s(), 3),
+            "generation": entry.generation,
+            "ready": False,
+        }
+        try:
+            with urllib.request.urlopen(
+                f"http://{entry.host}:{entry.port}/readyz", timeout=2
+            ) as resp:
+                report = json.loads(resp.read())
+            row["ready"] = bool(report.get("ready"))
+            row["generation"] = report.get("generation", entry.generation)
+        except Exception:
+            pass
+        hosts.setdefault(entry.host, []).append(row)
+    for rows in hosts.values():
+        rows.sort(key=lambda r: r["id"])
+    view = {
+        "directory": directory,
+        "ring": sorted(e.replica_id for e in live),
+        "hosts": hosts,
+        "staleLeases": sorted(e.replica_id for e in expired),
+        "problems": problems,
+    }
+    out(
+        f"  endpoints  {len(live)} live replica(s) across "
+        f"{len(hosts)} host(s) in {directory}"
+    )
+    for host in sorted(hosts):
+        rows = hosts[host]
+        out(
+            f"    {host}: "
+            + ", ".join(
+                f"{r['id']}:{r['port']} gen={r['generation']} "
+                f"lease={r['leaseAgeS']:.1f}s"
+                f"{' ready' if r['ready'] else ' NOT-READY'}"
+                for r in rows
+            )
+        )
+    if view["ring"]:
+        out(f"    ring members: {view['ring']}")
+    if view["staleLeases"]:
+        out(
+            f"    WARNING: stale leases (expired, not yet evicted): "
+            f"{view['staleLeases']}"
+        )
+    for problem in problems:
+        out(
+            f"    WARNING: torn registry entry {problem['file']}: "
+            f"{problem['error']}"
+        )
+    return view
 
 
 def _fleet_experiment(router_port) -> dict | None:

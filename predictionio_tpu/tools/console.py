@@ -202,6 +202,79 @@ def build_parser() -> argparse.ArgumentParser:
         help="router: seconds an open replica breaker waits before "
         "probing again — the fleet's recovery-time unit (default 1.0)",
     )
+    # ---- cross-host elastic fleet (ISSUE 17; docs/operations.md
+    # multi-host runbook). All strictly opt-in: a fleet-less deploy
+    # imports none of it, and a plain `--replicas N` fleet only gains
+    # registry-driven discovery (replicas bind port 0 and self-report —
+    # the pick-then-spawn port race is structurally gone).
+    deploy.add_argument(
+        "--endpoint-registry", default=None, metavar="DIR",
+        help="fleet: shared endpoint-registry directory (a shared "
+        "filesystem path) through which replicas on ANY host join this "
+        "router's consistent-hash ring — lease-stamped atomic entry "
+        "files, evicted on lease expiry, readable at GET "
+        "/fleet/endpoints.json (default: <basedir>/fleet/endpoints, "
+        "i.e. single-host unless pointed at a shared mount)",
+    )
+    deploy.add_argument(
+        "--router-only", action="store_true",
+        help="fleet: serve a router WITHOUT spawning replicas — a "
+        "second router sharing --endpoint-registry with the primary is "
+        "router-tier HA: same registry, same ring, client-visible "
+        "failover between the two",
+    )
+    deploy.add_argument(
+        "--autoscale", default="", metavar="MIN:MAX",
+        help="fleet: autoscale the replica fleet between MIN and MAX on "
+        "the watermarks below; scale-down retires drain-aware (SIGTERM "
+        "→ finish in-flight → withdraw registry entry; zero queries "
+        "lost). Requires --replicas (the initial size)",
+    )
+    deploy.add_argument(
+        "--scale-up-qps", type=float, default=50.0, metavar="Q",
+        help="autoscale: add a replica when per-replica q/s exceeds Q "
+        "(default 50)",
+    )
+    deploy.add_argument(
+        "--scale-up-p99-ms", type=float, default=250.0, metavar="MS",
+        help="autoscale: add a replica when router p99 exceeds MS "
+        "regardless of q/s (default 250)",
+    )
+    deploy.add_argument(
+        "--scale-down-qps", type=float, default=5.0, metavar="Q",
+        help="autoscale: drain one replica away when per-replica q/s "
+        "falls below Q and p99 is calm (default 5; must be < "
+        "--scale-up-qps — the gap is the hysteresis band)",
+    )
+    deploy.add_argument(
+        "--scale-cooldown-s", type=float, default=10.0, metavar="S",
+        help="autoscale: seconds between scaling actions (default 10)",
+    )
+    deploy.add_argument(
+        "--stale-cache-ttl-s", type=float, default=0.0, metavar="S",
+        help="router: keep each scope's last good answer S seconds and "
+        "serve it marked `X-PIO-Stale: true` ONLY when no replica can "
+        "serve at all — a fresh-capable scope never sees a stale "
+        "answer. 0 (default) disables the stale-while-down cache",
+    )
+    deploy.add_argument(
+        "--lease-ttl-s", type=float, default=5.0, metavar="S",
+        help="endpoint registry: seconds a replica's lease lives "
+        "between heartbeats; an entry unrenewed past this is evicted "
+        "from every router's ring (default 5)",
+    )
+    deploy.add_argument(
+        "--announce-dir", default=None, metavar="DIR",
+        help="replica: announce this server's actually-bound address "
+        "(use with --port 0) into the endpoint-registry directory and "
+        "heartbeat the lease — how a replica on another host joins a "
+        "fleet (set automatically by the fleet supervisor)",
+    )
+    deploy.add_argument(
+        "--announce-host", default="127.0.0.1", metavar="HOST",
+        help="replica: the address other hosts reach this replica at "
+        "(written into the registry entry; default 127.0.0.1)",
+    )
     deploy.add_argument("--feedback", action="store_true")
     deploy.add_argument("--event-server-ip", default="127.0.0.1")
     deploy.add_argument("--event-server-port", type=int, default=7070)
@@ -509,6 +582,15 @@ def build_parser() -> argparse.ArgumentParser:
         "non-vmappable sweep falls back to the sequential evaluator "
         "with the same output contract (docs/evaluation.md)",
     )
+    ev.add_argument(
+        "--promote-to", default=None, metavar="URL",
+        help="after the sweep, POST the winning candidate's variant to "
+        "URL/experiments/promote.json on a fleet router deployed with "
+        "--variants — the sweep's candidate order must match the "
+        "router's variant order (closing the eval → promote loop "
+        "without an operator POST). Example: --promote-to "
+        "http://127.0.0.1:8000",
+    )
 
     # ---- eventserver
     es = sub.add_parser("eventserver", help="start the event server")
@@ -659,6 +741,49 @@ def build_parser() -> argparse.ArgumentParser:
     cs.add_argument(
         "--keep", action="store_true",
         help="keep the scratch storage directory for inspection",
+    )
+
+    # ---- chaos-fleet (predictionio_tpu.resilience.chaos; ISSUE 17)
+    cf = sub.add_parser(
+        "chaos-fleet",
+        help="cross-host elastic-fleet drill: two 'hosts' (separate "
+        "basedirs) share one endpoint registry behind an HA router "
+        "pair; SIGKILL an entire host's fleet under concurrent "
+        "never-retrying clients (zero failed queries, the survivor "
+        "absorbs, the dead host rejoins via the registry), drive the "
+        "autoscaler through a watermark scale-up and a drain-aware "
+        "scale-down (zero in-flight loss), and prove the "
+        "stale-while-down cache serves marked answers only when every "
+        "replica is dead",
+    )
+    cf.add_argument(
+        "--replicas-per-host", type=_int_at_least(1), default=1,
+        help="replica fleet size on each 'host' (default 1)",
+    )
+    cf.add_argument(
+        "--clients", type=_int_at_least(1), default=16,
+        help="concurrent query clients (default 16)",
+    )
+    cf.add_argument(
+        "--seconds", type=float, default=6.0,
+        help="host-kill phase duration in seconds (default 6)",
+    )
+    cf.add_argument(
+        "--events", type=int, default=400,
+        help="synthetic training events (default 400)",
+    )
+    cf.add_argument(
+        "--lease-ttl-s", type=float, default=1.0,
+        help="endpoint-registry lease TTL under test (default 1.0)",
+    )
+    cf.add_argument("--seed", type=int, default=0, help="workload RNG seed")
+    cf.add_argument(
+        "--skip-autoscale", action="store_true",
+        help="skip the autoscaler phase (host-kill + stale only)",
+    )
+    cf.add_argument(
+        "--keep", action="store_true",
+        help="keep the scratch storage directories for inspection",
     )
 
     # ---- batchpredict
@@ -827,32 +952,30 @@ def _setup_compilation_cache() -> None:
         os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1.0")
 
 
-def _free_port() -> int:
-    import socket
-
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
-
-
-def _replica_argv(args, port: int, replica_id: str) -> list[str]:
+def _replica_argv(args, replica_id: str, announce_dir: str) -> list[str]:
     """Reconstruct a single-replica ``deploy`` argv from the parsed fleet
     args: every non-default deploy flag is carried over (so
     ``--shard-factors``/``--quantize``/``--ann``/... compose per
     replica), while the fleet/router flags, the public bind, and TLS are
     stripped — replicas listen plaintext on loopback (the router
-    terminates TLS) on their own port with their own identity. Derived
-    from the parsed namespace, not raw argv, so ``--flag=value`` spellings
-    and future flags need no special-casing."""
+    terminates TLS) with their own identity. Each replica binds **port
+    0** and self-reports its actually-bound address through the endpoint
+    registry (``--announce-dir``), so no port is ever picked before the
+    bind — the pick-then-spawn race is structurally impossible. Derived
+    from the parsed namespace, not raw argv, so ``--flag=value``
+    spellings and future flags need no special-casing."""
     defaults = build_parser().parse_args(["deploy"])
     skip = {
         "command",
         # fleet/router-only flags never reach a replica
         "replicas", "replica_id", "probe_interval_s", "failover_retries",
         "hedge_ms", "fleet_breaker_threshold", "fleet_breaker_reset_s",
-        "variants",
+        "variants", "endpoint_registry", "router_only", "autoscale",
+        "scale_up_qps", "scale_up_p99_ms", "scale_down_qps",
+        "scale_cooldown_s", "stale_cache_ttl_s",
         # rebound below / router-terminated
-        "ip", "port", "cert", "key",
+        "ip", "port", "cert", "key", "announce_dir", "announce_host",
+        "lease_ttl_s",
     }
     argv = ["-m", "predictionio_tpu.tools.console", "deploy"]
     for name, value in sorted(vars(args).items()):
@@ -866,15 +989,26 @@ def _replica_argv(args, port: int, replica_id: str) -> list[str]:
         else:
             argv.extend([flag, str(value)])
     argv.extend(
-        ["--ip", "127.0.0.1", "--port", str(port), "--replica-id", replica_id]
+        [
+            "--ip", "127.0.0.1", "--port", "0",
+            "--replica-id", replica_id,
+            "--announce-dir", announce_dir,
+            "--announce-host", args.announce_host,
+            "--lease-ttl-s", str(args.lease_ttl_s),
+        ]
     )
     return argv
 
 
 def _deploy_fleet(args) -> int:
-    """``pio deploy --replicas N``: spawn N replica subprocesses under
-    the self-healing supervisor and serve the fleet router on the public
-    port. SIGTERM/SIGINT, ``GET /stop`` (token-gated) and ``pio
+    """``pio deploy --replicas N`` (and ``--router-only``): spawn the
+    replica subprocesses under the self-healing supervisor and serve the
+    fleet router on the public port. Replicas bind port 0 and join the
+    ring by announcing their bound address through the shared endpoint
+    registry — the router starts with an EMPTY ring and reconciles
+    membership from the registry every probe interval, so replicas on
+    other hosts (same ``--endpoint-registry`` directory) join the same
+    ring. SIGTERM/SIGINT, ``GET /stop`` (token-gated) and ``pio
     undeploy`` all stop the WHOLE fleet — replicas must never outlive
     their router."""
     import atexit
@@ -884,6 +1018,7 @@ def _deploy_fleet(args) -> int:
     from predictionio_tpu.api.http import serve
     from predictionio_tpu.data.storage import Storage
     from predictionio_tpu.fleet import (
+        EndpointRegistry,
         FleetSupervisor,
         ModelRegistry,
         ReplicaSpec,
@@ -893,14 +1028,43 @@ def _deploy_fleet(args) -> int:
     )
     from predictionio_tpu.tools import commands
 
+    if args.router_only and args.autoscale:
+        raise SystemExit(
+            "--router-only serves no supervisor to scale; run --autoscale "
+            "on the fleet that owns the replicas"
+        )
     base_dir = Storage.base_dir()
+    endpoints_dir = args.endpoint_registry or os.path.join(
+        base_dir, "fleet", "endpoints"
+    )
+    endpoint_registry = EndpointRegistry(
+        endpoints_dir, lease_ttl_s=args.lease_ttl_s
+    )
+    # With an EXPLICIT shared registry, many supervisors feed one ring —
+    # replica ids minted per-host (r0, scale1, ...) would collide across
+    # hosts and silently overwrite each other's registry entries, so
+    # each id carries a host-unique token. The default (private)
+    # registry keeps the bare ids.
+    if args.endpoint_registry:
+        import socket
+
+        host_token = f"{socket.gethostname().split('.')[0]}-{os.getpid()}"
+
+        def _rid(base: str) -> str:
+            return f"{base}@{host_token}"
+    else:
+        def _rid(base: str) -> str:
+            return base
+
     specs: list[ReplicaSpec] = []
-    endpoints: list[tuple[str, str, int]] = []
-    for i in range(args.replicas):
-        rid = f"r{i}"
-        port = _free_port()
-        specs.append(ReplicaSpec(rid, port, tuple(_replica_argv(args, port, rid))))
-        endpoints.append((rid, "127.0.0.1", port))
+    if not args.router_only:
+        for i in range(args.replicas):
+            rid = _rid(f"r{i}")
+            specs.append(
+                ReplicaSpec(
+                    rid, 0, tuple(_replica_argv(args, rid, endpoints_dir))
+                )
+            )
     config = RouterConfig(
         probe_interval_s=args.probe_interval_s,
         failover_retries=args.failover_retries,
@@ -912,6 +1076,7 @@ def _deploy_fleet(args) -> int:
             if args.cache_scope_field.lower() in ("none", "")
             else args.cache_scope_field
         ),
+        stale_cache_ttl_s=args.stale_cache_ttl_s,
     )
     registry = ModelRegistry(os.path.join(base_dir, "fleet"))
     split = None
@@ -927,11 +1092,45 @@ def _deploy_fleet(args) -> int:
             )
             + f" (sticky by {config.scope_field or 'whole-body hash'})"
         )
-    router = RouterService(endpoints, config, registry=registry, split=split)
-    supervisor = FleetSupervisor(
-        specs, fleet_state_path(base_dir, args.port), args.port
+    router = RouterService(
+        [], config, registry=registry, split=split,
+        endpoint_registry=endpoint_registry,
     )
-    supervisor.start()
+    supervisor = None
+    autoscaler = None
+    if not args.router_only:
+        supervisor = FleetSupervisor(
+            specs, fleet_state_path(base_dir, args.port), args.port
+        )
+        supervisor.start()
+        if args.autoscale:
+            from predictionio_tpu.fleet.autoscaler import (
+                Autoscaler,
+                AutoscalerConfig,
+            )
+
+            lo, _, hi = args.autoscale.partition(":")
+            try:
+                scale_cfg = AutoscalerConfig(
+                    min_replicas=int(lo),
+                    max_replicas=int(hi or lo),
+                    scale_up_qps=args.scale_up_qps,
+                    scale_up_p99_ms=args.scale_up_p99_ms,
+                    scale_down_qps=args.scale_down_qps,
+                    cooldown_s=args.scale_cooldown_s,
+                )
+            except ValueError as e:
+                raise SystemExit(f"--autoscale: {e}")
+            autoscaler = Autoscaler(
+                router,
+                supervisor,
+                lambda rid: ReplicaSpec(
+                    _rid(rid), 0,
+                    tuple(_replica_argv(args, _rid(rid), endpoints_dir)),
+                ),
+                scale_cfg,
+            )
+            autoscaler.start()
     router.start()
     stopped = threading.Event()
 
@@ -939,8 +1138,11 @@ def _deploy_fleet(args) -> int:
         if stopped.is_set():
             return
         stopped.set()
+        if autoscaler is not None:
+            autoscaler.stop()
         router.close()
-        supervisor.stop()
+        if supervisor is not None:
+            supervisor.stop()
 
     atexit.register(shutdown_fleet)
 
@@ -956,15 +1158,16 @@ def _deploy_fleet(args) -> int:
 
         router.stop_server = stop_all
         # first signal stops the fleet (replicas get SIGTERM, so each
-        # drains per its own --drain-deadline-s); the router's listener
-        # follows once children are down
+        # drains per its own --drain-deadline-s, withdraws its registry
+        # entry, and only then exits); the router's listener follows
         _signal.signal(_signal.SIGTERM, lambda s, f: stop_all())
         _signal.signal(_signal.SIGINT, lambda s, f: stop_all())
 
+    role = "HA router" if args.router_only else "router"
     print(
-        f"Fleet is deployed: router on {args.ip}:{args.port}, "
-        f"{args.replicas} replica(s) on "
-        f"{', '.join(str(p) for _, _, p in endpoints)}"
+        f"Fleet is deployed: {role} on {args.ip}:{args.port}, "
+        f"{len(specs)} replica(s) self-reporting via {endpoints_dir}"
+        + (f", autoscale {args.autoscale}" if autoscaler else "")
     )
     serve(
         router.dispatch, args.ip, args.port,
@@ -972,6 +1175,63 @@ def _deploy_fleet(args) -> int:
     )
     shutdown_fleet()
     return 0
+
+
+def _start_announcer(args, service, server) -> None:
+    """Replica self-report (ISSUE 17): publish this server's
+    *actually-bound* address (``--port 0`` capable — the port is read
+    off the live socket, never picked in advance) into the shared
+    endpoint registry, heartbeat the lease, and withdraw on drain/exit
+    so clean retirement leaves no entry to expire. Lazy import: only
+    ``--announce-dir`` pays for the fleet module."""
+    import atexit
+    import threading
+
+    from predictionio_tpu.fleet.registry import EndpointRegistry
+
+    host, port = args.announce_host, server.server_address[1]
+    rid = args.replica_id or f"pid{os.getpid()}"
+    registry = EndpointRegistry(
+        args.announce_dir, lease_ttl_s=args.lease_ttl_s
+    )
+    stop = threading.Event()
+
+    def generation() -> int:
+        try:
+            return int(getattr(service, "model_generation", 0) or 0)
+        except (TypeError, ValueError):
+            return 0
+
+    registry.announce(rid, host, port, generation=generation())
+    print(
+        f"Announced replica {rid} at {host}:{port} in "
+        f"{args.announce_dir} (lease {args.lease_ttl_s:g}s)"
+    )
+
+    def heartbeat() -> None:
+        interval = max(0.05, args.lease_ttl_s / 3.0)
+        while not stop.wait(interval):
+            try:
+                registry.heartbeat(rid, host, port, generation=generation())
+            except OSError:
+                pass  # sharedfs hiccup: the next beat renews the lease
+
+    threading.Thread(
+        target=heartbeat, name="endpoint-heartbeat", daemon=True
+    ).start()
+
+    def withdraw() -> None:
+        stop.set()
+        try:
+            registry.withdraw(rid)
+        except OSError:
+            pass
+
+    # drain withdraws FIRST (routers reconcile this replica out before
+    # the listener closes); atexit covers non-drain exits
+    if hasattr(service, "on_close"):
+        service.on_close.append(withdraw)
+    atexit.register(withdraw)
 
 
 def _lifecycle_from_args(args):
@@ -998,6 +1258,65 @@ def _lifecycle_from_args(args):
     # section of GET /stats.json on servers that serve one
     resilience.register_stats("lifecycle", lifecycle)
     return lifecycle
+
+
+def _promote_winner(router_url: str, result) -> dict:
+    """``pio eval --grid --promote-to URL``: close the sweep → promote
+    loop (ROADMAP item 4's leftover). Maps the sweep's winning candidate
+    INDEX onto the router's variant ORDER — ``GET /experiments.json``
+    lists variants in ``--variants`` order, so the operator deploys one
+    variant per sweep candidate in the same order — then POSTs the
+    promotion (which rolls the fleet). Loud ``SystemExit`` on any
+    mismatch: a silently mis-mapped promotion would roll the wrong model
+    fleet-wide."""
+    import urllib.error
+    import urllib.request
+
+    url = router_url.rstrip("/")
+    try:
+        with urllib.request.urlopen(
+            url + "/experiments.json", timeout=10
+        ) as r:
+            experiments = json.load(r)
+    except (urllib.error.URLError, json.JSONDecodeError, OSError) as e:
+        raise SystemExit(
+            f"--promote-to: cannot read {url}/experiments.json: {e}"
+        )
+    variants = [v.get("name") for v in experiments.get("variants", [])]
+    candidates = len(result.engine_params_scores)
+    if len(variants) != candidates:
+        raise SystemExit(
+            f"--promote-to: the router serves {len(variants)} variant(s) "
+            f"{variants} but the sweep scored {candidates} candidate(s) — "
+            "refusing to guess the mapping; deploy --variants with one "
+            "variant per sweep candidate, in the same order"
+        )
+    winner = variants[result.best_index]
+    req = urllib.request.Request(
+        url + "/experiments/promote.json",
+        data=json.dumps({"variant": winner}).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    try:
+        # the promotion rolls every replica through /reload — budget it
+        # like a rolling reload, not like a GET
+        with urllib.request.urlopen(req, timeout=600) as r:
+            payload = json.load(r)
+            status = r.status
+    except urllib.error.HTTPError as e:
+        raise SystemExit(
+            f"--promote-to: promotion of {winner!r} failed "
+            f"({e.code}): {e.read()[:300]!r}"
+        )
+    except (urllib.error.URLError, json.JSONDecodeError, OSError) as e:
+        raise SystemExit(f"--promote-to: promotion of {winner!r} failed: {e}")
+    return {
+        "promotedVariant": winner,
+        "bestIndex": result.best_index,
+        "status": status,
+        "router": payload,
+    }
 
 
 def _ssl_from_args(args):
@@ -1089,10 +1408,11 @@ def main(argv: list[str] | None = None) -> int:
             )
             print(f"Training completed. Engine instance: {instance.id}")
         elif cmd == "deploy":
-            if args.replicas and args.replicas > 0:
-                # replica-fleet path (ISSUE 15): router + N replica
-                # subprocesses. Gated here so a fleet-less deploy never
-                # imports predictionio_tpu.fleet (CI-guarded).
+            if (args.replicas and args.replicas > 0) or args.router_only:
+                # replica-fleet path (ISSUE 15/17): router + replica
+                # subprocesses (or a bare HA router). Gated here so a
+                # fleet-less deploy never imports predictionio_tpu.fleet
+                # (CI-guarded).
                 return _deploy_fleet(args)
             from predictionio_tpu import resilience
             from predictionio_tpu.api.http import serve
@@ -1215,13 +1535,19 @@ def main(argv: list[str] | None = None) -> int:
                 # helper thread (shutdown() from a handler would deadlock).
                 # The stop token is written only after a successful bind so
                 # a failed re-deploy on a busy port cannot clobber the live
-                # deployment's token file.
+                # deployment's token file. Keyed by the BOUND port, so
+                # --port 0 deployments get a usable token too.
                 import threading
 
-                service.stop_token = commands.write_stop_token(args.port)
+                bound_port = server.server_address[1]
+                service.stop_token = commands.write_stop_token(bound_port)
                 service.stop_server = lambda: threading.Thread(
                     target=server.shutdown, daemon=True
                 ).start()
+                if args.port == 0:
+                    print(f"Bound port {bound_port}")
+                if args.announce_dir:
+                    _start_announcer(args, service, server)
 
             print(f"Engine is deployed and running. Listening on {args.ip}:{args.port}")
             serve(
@@ -1277,6 +1603,9 @@ def main(argv: list[str] | None = None) -> int:
             with open(args.output_path, "w") as f:
                 json.dump(result.to_json(), f, indent=2, default=str)
             print(f"Best params written to {args.output_path}")
+            if args.promote_to:
+                report = _promote_winner(args.promote_to, result)
+                print(json.dumps(report, indent=2, default=str))
         elif cmd == "eventserver":
             from predictionio_tpu.api import EventService
             from predictionio_tpu.api.http import serve
@@ -1581,6 +1910,30 @@ def main(argv: list[str] | None = None) -> int:
                     train_events=args.events,
                     seed=args.seed,
                     sharded_point=args.sharded_point,
+                    keep_dir=args.keep,
+                )
+            )
+            print(json.dumps(report, indent=2))
+            return 0 if report["ok"] else 1
+        elif cmd == "chaos-fleet":
+            # cross-host elastic-fleet drill (ISSUE 17): two-"host" kill
+            # with HA router failover, autoscaler watermark scale-up +
+            # drain-aware scale-down, stale-while-down proof
+            # (docs/operations.md "Multi-host fleet runbook")
+            from predictionio_tpu.resilience.chaos import (
+                FleetChaosConfig,
+                run_chaos_fleet,
+            )
+
+            report = run_chaos_fleet(
+                FleetChaosConfig(
+                    replicas_per_host=args.replicas_per_host,
+                    clients=args.clients,
+                    phase_seconds=args.seconds,
+                    train_events=args.events,
+                    lease_ttl_s=args.lease_ttl_s,
+                    seed=args.seed,
+                    autoscale_phase=not args.skip_autoscale,
                     keep_dir=args.keep,
                 )
             )

@@ -230,6 +230,10 @@ class QueryService:
         #: (console deploy generates one and shares it with undeploy
         #: via a basedir token file)
         self.stop_token: str | None = None
+        #: callbacks run first by :meth:`close` (and therefore by the
+        #: drain path) — e.g. the endpoint-registry withdraw wired by
+        #: ``pio deploy --announce-dir``
+        self.on_close: list = []
         # one long-lived worker drains feedback posts — per-query threads
         # would grow unboundedly when the event server is slow
         self._feedback_queue: "queue.Queue | None" = None
@@ -1064,8 +1068,17 @@ class QueryService:
 
     def close(self) -> None:
         """Release background resources (the batcher's dispatcher thread
-        and the online follower/trainer threads). Safe to call more than
-        once; queued requests get a 503."""
+        and the online follower/trainer threads) and run the ``on_close``
+        callbacks (e.g. the endpoint-registry withdraw the console wires
+        under ``--announce-dir``, so a draining replica leaves the ring
+        cleanly instead of waiting out its lease). Safe to call more
+        than once; queued requests get a 503."""
+        callbacks, self.on_close = self.on_close, []
+        for cb in callbacks:
+            try:
+                cb()
+            except Exception as e:  # closing must never fail the drain
+                logger.warning("on_close callback failed: %s", e)
         if self.online is not None:
             self.online.stop()
             self.online = None

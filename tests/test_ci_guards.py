@@ -494,6 +494,68 @@ def test_fleet_defaults_are_opt_in():
     assert proc.returncode == 0, proc.stderr.decode()[-500:]
 
 
+def test_elastic_fleet_defaults_are_opt_in():
+    """ISSUE 17 guard: the cross-host elastic fleet (endpoint registry,
+    autoscaler, router HA, stale-while-down) is strictly opt-in. Default
+    ``pio deploy`` parses with every elastic flag off, never imports the
+    registry or autoscaler modules, and the fleet package — including
+    the new registry.py and autoscaler.py — stays pinned stdlib-only by
+    the piolint manifest."""
+    from predictionio_tpu.tools.console import build_parser
+
+    args = build_parser().parse_args(["deploy"])
+    assert args.endpoint_registry is None  # sharedfs registry off
+    assert args.router_only is False  # HA second router off
+    assert args.autoscale == ""  # autoscaler off
+    assert args.stale_cache_ttl_s == 0.0  # stale-while-down off
+    assert args.announce_dir is None  # self-announce off
+    # tunables keep documented defaults (docs/serving.md flag table)
+    assert args.lease_ttl_s == 5.0
+    assert args.scale_up_qps == 50.0
+    assert args.scale_up_p99_ms == 250.0
+    assert args.scale_down_qps == 5.0
+    assert args.scale_cooldown_s == 10.0
+    # default deploy path never pulls in the elastic modules even when
+    # the rest of the console machinery loads
+    probe = (
+        "import sys; "
+        "import predictionio_tpu.tools.console; "
+        "import predictionio_tpu.tools.commands; "
+        "bad = [m for m in sys.modules if m in ("
+        "'predictionio_tpu.fleet.registry', "
+        "'predictionio_tpu.fleet.autoscaler')]; "
+        "sys.exit(1 if bad else 0)"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", probe], cwd=REPO, capture_output=True
+    )
+    assert proc.returncode == 0, proc.stderr.decode()[-500:]
+    # manifest: the stdlib-only fleet rule covers the NEW files too —
+    # a future import of jax/storage from registry.py or autoscaler.py
+    # must trip piolint, not slide under a stale package pin
+    from predictionio_tpu.analysis.manifest import DEFAULT_MANIFEST, rules_for
+
+    for rel in (
+        "predictionio_tpu/fleet/registry.py",
+        "predictionio_tpu/fleet/autoscaler.py",
+        "predictionio_tpu/fleet/router.py",
+    ):
+        hits = rules_for(rel, DEFAULT_MANIFEST)
+        assert hits and hits[0].package == "predictionio_tpu/fleet", rel
+        assert hits[0].stdlib_only, rel
+    # registry + autoscaler import without jax (stdlib-only in practice)
+    probe = (
+        "import sys; "
+        "import predictionio_tpu.fleet.registry; "
+        "import predictionio_tpu.fleet.autoscaler; "
+        "sys.exit(1 if 'jax' in sys.modules else 0)"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", probe], cwd=REPO, capture_output=True
+    )
+    assert proc.returncode == 0, proc.stderr.decode()[-500:]
+
+
 def test_experiments_defaults_are_opt_in():
     """ISSUE 16 guard: experimentation is strictly opt-in. Without
     ``--explore``/``--variants`` (and without ``pio eval --grid``)
@@ -1125,6 +1187,57 @@ def test_bench_smoke_runs_green():
     assert fsharded["failed"] == 0 and fsharded["transportErrors"] == 0
     assert fsharded["qps"] > 0
     assert fleet["ok"] is True, f"serving_fleet verdict failed: {fleet}"
+    # elastic-fleet section (ISSUE 17 acceptance): two registry-joined
+    # "hosts" under HA routers survive SIGKILLing one host's entire
+    # fleet with ZERO failed queries (the survivor absorbs, the dead
+    # host's leases evict, a restarted host rejoins the same ring);
+    # the autoscaler walks 1->2->1 through a watermark scale-up and a
+    # drain-aware retirement without losing a trickle query; and the
+    # stale-while-down cache serves ONLY when every owner replica is
+    # dead — marked X-PIO-Stale — never for a fresh-capable scope
+    elastic = detail.get("fleet_elastic")
+    assert elastic is not None, "missing bench section 'fleet_elastic'"
+    assert "error" not in elastic, f"fleet_elastic errored: {elastic}"
+    hk = elastic["hostKill"]
+    assert hk["failedQueries"] == 0, (
+        f"host-kill leaked failed queries to HA clients: {hk}"
+    )
+    assert hk["overall"]["requests"] > 0
+    assert hk["absorbSeconds"] is not None, (
+        f"survivor host never absorbed the dead host's scopes: {hk}"
+    )
+    assert hk["evictSeconds"] is not None, (
+        f"dead host's leases were never evicted from the ring: {hk}"
+    )
+    assert hk["rejoinSeconds"] is not None, (
+        f"restarted host never rejoined the shared ring: {hk}"
+    )
+    auto = elastic["autoscale"]
+    assert auto["scaleUpSeconds"] is not None, (
+        f"autoscaler never scaled up past the q/s watermark: {auto}"
+    )
+    assert auto["scaleDownSeconds"] is not None, (
+        f"autoscaler never drained back down to the floor: {auto}"
+    )
+    assert auto["failedQueries"] == 0, (
+        f"autoscale transitions leaked failed queries: {auto}"
+    )
+    assert auto["trickle"]["requests"] > 0
+    assert auto["trickle"]["failed"] == 0, (
+        f"drain-aware retirement lost trickle queries: {auto}"
+    )
+    stale = elastic["staleWhileDown"]
+    assert stale["freshStatus"] == 200 and stale["freshMarked"] is False
+    assert stale["staleStatus"] == 200 and stale["staleMarked"] is True, (
+        f"all-owners-down scope did not serve marked stale: {stale}"
+    )
+    assert stale["uncachedStatus"] == 503 and stale["uncachedMarked"] is False
+    assert stale["freshAfterStatus"] == 200
+    assert stale["freshAfterMarked"] is False, (
+        f"stale marker leaked onto a fresh-capable response: {stale}"
+    )
+    assert stale["ok"] is True, f"staleWhileDown verdict failed: {stale}"
+    assert elastic["ok"] is True, f"fleet_elastic verdict failed: {elastic}"
     # experimentation section (ISSUE 16 acceptance): on the seeded
     # closed reward loop Thompson exploration must end with LOWER
     # cumulative true-reward regret than the exploit-only policy run

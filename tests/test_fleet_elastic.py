@@ -1,0 +1,610 @@
+"""Elastic-fleet tests (ISSUE 17): the endpoint registry's sharedfs
+robustness (torn entries loud, expired leases evicted exactly once,
+racing writers converge), the router's registry-driven ring membership
+and stale-while-down cache, the watermark autoscaler's decisions, the
+supervisor's add/retire dynamics, and the eval promotion POST.
+
+Same philosophy as tests/test_fleet_router.py: scriptable in-process
+stub backends over real HTTP, no subprocess fleets — the real
+subprocess drill is ``pio chaos-fleet`` (bench ``fleet_elastic``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import types
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from predictionio_tpu.fleet import (
+    Autoscaler,
+    AutoscalerConfig,
+    EndpointRegistry,
+    FleetSupervisor,
+    ReplicaSpec,
+    RouterConfig,
+    RouterService,
+)
+from tests.test_fleet_router import StubReplica, stubs  # noqa: F401 (fixture)
+
+
+def make_registry_router(
+    reg: EndpointRegistry, **config_kwargs
+) -> RouterService:
+    config = RouterConfig(
+        probe_interval_s=0.05,
+        breaker_reset_s=0.5,
+        request_timeout_s=5.0,
+        **config_kwargs,
+    )
+    return RouterService([], config, endpoint_registry=reg)
+
+
+class TestEndpointRegistry:
+    def test_announce_heartbeat_withdraw_roundtrip(self, tmp_path):
+        reg = EndpointRegistry(str(tmp_path), lease_ttl_s=5.0)
+        reg.announce("r0", "127.0.0.1", 1234, generation=3)
+        live, expired, problems = reg.snapshot()
+        assert [e.replica_id for e in live] == ["r0"]
+        assert (live[0].host, live[0].port, live[0].generation) == (
+            "127.0.0.1", 1234, 3
+        )
+        assert expired == [] and problems == []
+        # heartbeat extends the lease (an atomic whole-entry rewrite)
+        before = live[0].lease_expires
+        time.sleep(0.01)
+        reg.heartbeat("r0", "127.0.0.1", 1234, generation=3)
+        assert reg.live()[0].lease_expires > before
+        assert reg.withdraw("r0") is True
+        assert reg.snapshot() == ([], [], [])
+        assert reg.withdraw("r0") is False  # already gone
+
+    def test_expired_lease_is_reported_then_evicted(self, tmp_path):
+        reg = EndpointRegistry(str(tmp_path), lease_ttl_s=1.0)
+        backdated = time.time() - 100.0
+        reg.announce("r0", "127.0.0.1", 1234, now=backdated)
+        live, expired, problems = reg.snapshot()
+        assert live == [] and problems == []
+        assert [e.replica_id for e in expired] == ["r0"]
+        assert reg.evict_expired() == ["r0"]
+        assert reg.snapshot() == ([], [], [])
+
+    def test_torn_entry_degrades_loudly_not_silently(self, tmp_path):
+        reg = EndpointRegistry(str(tmp_path), lease_ttl_s=60.0)
+        reg.announce("good", "127.0.0.1", 1)
+        torn = tmp_path / "torn.endpoint.json"
+        torn.write_text('{"replicaId": "torn", "host')  # half a write
+        live, expired, problems = reg.snapshot()
+        assert [e.replica_id for e in live] == ["good"]
+        # the torn file is REPORTED, never silently skipped
+        assert len(problems) == 1
+        assert problems[0]["file"].endswith("torn.endpoint.json")
+        assert problems[0]["error"]
+        # fresh torn files are left for their writer to finish...
+        assert reg.evict_expired() == []
+        assert torn.exists()
+        # ...but a torn file older than one lease TTL is abandoned
+        # garbage and gets claimed like an expired lease
+        old = time.time() - 120.0
+        os.utime(torn, (old, old))
+        evicted = EndpointRegistry(str(tmp_path), lease_ttl_s=60.0)
+        assert evicted.evict_expired() != []
+        assert not torn.exists()
+
+    def test_expired_leases_evicted_exactly_once_across_ha_pair(
+        self, tmp_path
+    ):
+        """Two registry instances sharing the directory (the router-HA
+        pair): every expired entry is claimed by exactly one."""
+        writer = EndpointRegistry(str(tmp_path), lease_ttl_s=1.0)
+        backdated = time.time() - 100.0
+        ids = [f"r{i}" for i in range(8)]
+        for rid in ids:
+            writer.announce(rid, "127.0.0.1", 1, now=backdated)
+        a = EndpointRegistry(str(tmp_path), lease_ttl_s=1.0)
+        b = EndpointRegistry(str(tmp_path), lease_ttl_s=1.0)
+        results: dict[str, list[str]] = {}
+        barrier = threading.Barrier(2)
+
+        def run(name: str, reg: EndpointRegistry) -> None:
+            barrier.wait()
+            results[name] = reg.evict_expired()
+
+        threads = [
+            threading.Thread(target=run, args=("a", a)),
+            threading.Thread(target=run, args=("b", b)),
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert sorted(results["a"] + results["b"]) == ids  # union complete
+        assert not set(results["a"]) & set(results["b"])  # claims disjoint
+        assert writer.snapshot() == ([], [], [])
+
+    def test_racing_writers_on_one_entry_converge(self, tmp_path):
+        """N threads re-announcing the same replica id concurrently must
+        leave ONE parseable entry and no stray temp files — the atomic
+        mkstemp+fsync+replace contract under contention."""
+        reg = EndpointRegistry(str(tmp_path), lease_ttl_s=60.0)
+        errors: list[Exception] = []
+
+        def writer(n: int) -> None:
+            try:
+                for i in range(25):
+                    reg.announce("shared", "127.0.0.1", 1000 + n,
+                                 generation=i)
+            except Exception as e:  # pragma: no cover - the assertion
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=writer, args=(n,)) for n in range(4)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert errors == []
+        live, expired, problems = reg.snapshot()
+        assert [e.replica_id for e in live] == ["shared"]
+        assert expired == [] and problems == []
+        # every temp file was cleaned up (mkstemp prefix ".endpoint.")
+        leftovers = [
+            f for f in os.listdir(tmp_path) if f.startswith(".endpoint.")
+        ]
+        assert leftovers == []
+
+
+class TestRouterMembership:
+    def test_replicas_join_and_leave_through_the_registry(
+        self, tmp_path, stubs  # noqa: F811
+    ):
+        a, b = stubs(2)
+        reg = EndpointRegistry(str(tmp_path), lease_ttl_s=60.0)
+        router = make_registry_router(reg)
+        assert router.replicas == []
+        reg.announce("r0", "127.0.0.1", a.port)
+        reg.announce("r1", "127.0.0.1", b.port)
+        report = router.reconcile_endpoints()
+        assert sorted(report["joined"]) == ["r0", "r1"]
+        router.probe_all()
+        resp = router.dispatch(
+            "POST", "/queries.json", {}, {"user": "u1", "num": 4}
+        )
+        assert resp.status == 200
+        assert router.stats.to_json()["membershipChanges"] == 2
+        # a clean withdrawal (drain-retirement) leaves the ring
+        reg.withdraw("r1")
+        report = router.reconcile_endpoints()
+        assert report["left"] == ["r1"]
+        assert sorted(router._by_id) == ["r0"]
+
+    def test_respawned_replica_at_a_new_port_is_repointed(
+        self, tmp_path, stubs  # noqa: F811
+    ):
+        # a supervisor-respawned replica keeps its id but re-binds
+        # port 0 — the router must move the ring member to the new
+        # address, not keep probing the corpse
+        a, b = stubs(2)
+        reg = EndpointRegistry(str(tmp_path), lease_ttl_s=60.0)
+        router = make_registry_router(reg)
+        reg.announce("r0", "127.0.0.1", a.port)
+        router.reconcile_endpoints()
+        assert router._by_id["r0"].port == a.port
+        a.close()
+        reg.announce("r0", "127.0.0.1", b.port)  # same id, new address
+        report = router.reconcile_endpoints()
+        assert report["moved"] == ["r0"]
+        assert router._by_id["r0"].port == b.port
+        router.probe_all()
+        resp = router.dispatch(
+            "POST", "/queries.json", {}, {"user": "u1", "num": 4}
+        )
+        assert resp.status == 200
+
+    def test_lease_expiry_evicts_and_ha_pair_never_double_counts(
+        self, tmp_path, stubs  # noqa: F811
+    ):
+        a, b = stubs(2)
+        reg_dir = str(tmp_path)
+        r1 = make_registry_router(
+            EndpointRegistry(reg_dir, lease_ttl_s=1.0)
+        )
+        r2 = make_registry_router(
+            EndpointRegistry(reg_dir, lease_ttl_s=1.0)
+        )
+        backdated = time.time() - 100.0
+        writer = EndpointRegistry(reg_dir, lease_ttl_s=1.0)
+        writer.announce("r0", "127.0.0.1", a.port)
+        writer.announce("r1", "127.0.0.1", b.port)
+        for router in (r1, r2):
+            router.reconcile_endpoints()
+            assert sorted(router._by_id) == ["r0", "r1"]
+        # both leases expire; both routers reconcile concurrently
+        writer.announce("r0", "127.0.0.1", a.port, now=backdated)
+        writer.announce("r1", "127.0.0.1", b.port, now=backdated)
+        barrier = threading.Barrier(2)
+
+        def reconcile(router: RouterService) -> None:
+            barrier.wait()
+            router.reconcile_endpoints()
+
+        threads = [
+            threading.Thread(target=reconcile, args=(r,)) for r in (r1, r2)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        # the membership change is visible at BOTH routers...
+        assert r1._by_id == {} and r2._by_id == {}
+        # ...but each eviction was CLAIMED (and counted) exactly once
+        evictions = (
+            r1.stats.to_json()["leaseEvictions"]
+            + r2.stats.to_json()["leaseEvictions"]
+        )
+        assert evictions == 2
+
+    def test_endpoints_json_reports_registry_and_ring(
+        self, tmp_path, stubs  # noqa: F811
+    ):
+        (a,) = stubs(1)
+        reg = EndpointRegistry(str(tmp_path), lease_ttl_s=60.0)
+        router = make_registry_router(reg)
+        reg.announce("r0", "127.0.0.1", a.port, generation=2)
+        router.reconcile_endpoints()
+        resp = router.dispatch("GET", "/fleet/endpoints.json", {})
+        assert resp.status == 200
+        doc = json.loads(resp.json_bytes())
+        assert doc["ring"] == ["r0"]
+        assert doc["registry"]["live"][0]["replicaId"] == "r0"
+        assert doc["registry"]["live"][0]["leaseAgeSeconds"] >= 0
+
+
+class TestStaleWhileDown:
+    def _route(self, router, body):
+        return router.dispatch("POST", "/queries.json", {}, body)
+
+    def test_stale_served_only_when_every_owner_is_down(self, stubs):  # noqa: F811
+        (a,) = stubs(1)
+        config = RouterConfig(
+            probe_interval_s=0.05,
+            breaker_reset_s=0.5,
+            request_timeout_s=5.0,
+            stale_cache_ttl_s=30.0,
+        )
+        router = RouterService([("r0", "127.0.0.1", a.port)], config)
+        router.probe_all()
+        body = {"user": "u1", "num": 4}
+        fresh = self._route(router, body)
+        assert fresh.status == 200
+        assert "X-PIO-Stale" not in fresh.headers
+        # the only owner dies; the cached scope is served marked-stale
+        a.close()
+        router.probe_all()
+        stale = self._route(router, body)
+        assert stale.status == 200
+        assert stale.headers["X-PIO-Stale"] == "true"
+        assert json.loads(stale.json_bytes())["replica"] == "r0"
+        # an uncached scope is still a truthful 503, never a fake answer
+        miss = self._route(router, {"user": "u-never", "num": 4})
+        assert miss.status == 503
+        assert "X-PIO-Stale" not in miss.headers
+        assert router.stats.to_json()["staleServed"] == 1
+
+    def test_fresh_capable_scope_is_never_served_stale(self, stubs):  # noqa: F811
+        a, b = stubs(2)
+        config = RouterConfig(
+            probe_interval_s=0.05,
+            breaker_reset_s=0.5,
+            request_timeout_s=5.0,
+            stale_cache_ttl_s=30.0,
+        )
+        router = RouterService(
+            [(s.rid, "127.0.0.1", s.port) for s in (a, b)], config
+        )
+        router.probe_all()
+        from tests.test_fleet_router import owner_user
+
+        body = owner_user(router, "r0")
+        assert self._route(router, body).status == 200
+        a.behavior["/queries.json"] = "die"  # the owner dies mid-request
+        resp = self._route(router, body)
+        # failover to the live peer wins over the cached answer
+        assert resp.status == 200
+        assert json.loads(resp.json_bytes())["replica"] == "r1"
+        assert "X-PIO-Stale" not in resp.headers
+        assert router.stats.to_json()["staleServed"] == 0
+
+    def test_stale_cache_ttl_bounds_the_lie(self, stubs):  # noqa: F811
+        (a,) = stubs(1)
+        config = RouterConfig(
+            probe_interval_s=0.05,
+            breaker_reset_s=0.5,
+            request_timeout_s=5.0,
+            stale_cache_ttl_s=0.2,
+        )
+        router = RouterService([("r0", "127.0.0.1", a.port)], config)
+        router.probe_all()
+        body = {"user": "u1", "num": 4}
+        assert self._route(router, body).status == 200
+        a.close()
+        router.probe_all()
+        time.sleep(0.25)  # past the TTL: the cached answer is too old
+        resp = self._route(router, body)
+        assert resp.status == 503
+        assert "X-PIO-Stale" not in resp.headers
+
+
+class _FakeRouter:
+    def __init__(self):
+        self.load = {"qps": 0.0, "p99Seconds": 0.0}
+
+    def load_snapshot(self, window_s: float = 5.0) -> dict:
+        return dict(self.load)
+
+
+class _FakeSupervisor:
+    def __init__(self, ids):
+        self._lock = threading.Lock()
+        self.specs = [ReplicaSpec(i, 0, ("-c", "pass")) for i in ids]
+        self.added: list[str] = []
+        self.retired: list[str] = []
+        self.retiring = 0
+
+    def add_replica(self, spec) -> None:
+        self.specs.append(spec)
+        self.added.append(spec.replica_id)
+
+    def retire_replica(self, rid: str) -> bool:
+        self.specs = [s for s in self.specs if s.replica_id != rid]
+        self.retired.append(rid)
+        return True
+
+    def retiring_count(self) -> int:
+        return self.retiring
+
+
+def make_autoscaler(ids=("r0",), **cfg_kwargs):
+    cfg_kwargs.setdefault("cooldown_s", 0.0)
+    cfg = AutoscalerConfig(
+        min_replicas=1,
+        max_replicas=3,
+        scale_up_qps=10.0,
+        scale_up_p99_ms=250.0,
+        scale_down_qps=2.0,
+        **cfg_kwargs,
+    )
+    router = _FakeRouter()
+    sup = _FakeSupervisor(list(ids))
+    scaler = Autoscaler(
+        router, sup, lambda rid: ReplicaSpec(rid, 0, ("-c", "pass")), cfg
+    )
+    return scaler, router, sup
+
+
+class TestAutoscaler:
+    def test_config_enforces_the_hysteresis_band(self):
+        with pytest.raises(ValueError):
+            AutoscalerConfig(scale_up_qps=5.0, scale_down_qps=5.0)
+        with pytest.raises(ValueError):
+            AutoscalerConfig(min_replicas=0)
+        with pytest.raises(ValueError):
+            AutoscalerConfig(min_replicas=3, max_replicas=2)
+
+    def test_decide_watermarks(self):
+        scaler, _, _ = make_autoscaler()
+        up, down, hold = "up", "down", "hold"
+        assert scaler.decide({"qps": 0.0, "p99Seconds": 0.0}, 0) == up
+        # per-replica q/s over the high watermark
+        assert scaler.decide({"qps": 25.0, "p99Seconds": 0.0}, 2) == up
+        # p99 pressure alone scales up
+        assert scaler.decide({"qps": 1.0, "p99Seconds": 0.5}, 2) == up
+        # at max: hold no matter the pressure
+        assert scaler.decide({"qps": 999.0, "p99Seconds": 9.0}, 3) == hold
+        # inside the hysteresis band: hold
+        assert scaler.decide({"qps": 10.0, "p99Seconds": 0.0}, 2) == hold
+        # calm: drain one away — but never below min
+        assert scaler.decide({"qps": 1.0, "p99Seconds": 0.0}, 2) == down
+        assert scaler.decide({"qps": 0.0, "p99Seconds": 0.0}, 1) == hold
+
+    def test_evaluate_scales_up_then_retires_drain_aware(self):
+        scaler, router, sup = make_autoscaler()
+        router.load = {"qps": 50.0, "p99Seconds": 0.0}
+        outcome = scaler.evaluate_once()
+        assert outcome["action"] == "up" and outcome["applied"]
+        assert sup.added == ["scale1"]
+        router.load = {"qps": 0.5, "p99Seconds": 0.0}
+        # a replica still draining gates further scale-down
+        sup.retiring = 1
+        assert scaler.evaluate_once()["action"] == "down_waiting_drain"
+        assert sup.retired == []
+        sup.retiring = 0
+        outcome = scaler.evaluate_once()
+        assert outcome["action"] == "down" and outcome["applied"]
+        # the youngest scaled-up replica is retired first
+        assert sup.retired == ["scale1"]
+        assert (scaler.scale_ups, scaler.scale_downs) == (1, 1)
+
+    def test_cooldown_damps_consecutive_actions(self):
+        scaler, router, sup = make_autoscaler(cooldown_s=60.0)
+        router.load = {"qps": 50.0, "p99Seconds": 0.0}
+        assert scaler.evaluate_once()["applied"]
+        outcome = scaler.evaluate_once()
+        assert outcome["action"] == "up_cooldown"
+        assert not outcome["applied"]
+        assert sup.added == ["scale1"]
+
+    def test_minted_ids_avoid_taken_ones(self):
+        scaler, router, sup = make_autoscaler(ids=("r0", "scale1"))
+        router.load = {"qps": 99.0, "p99Seconds": 0.0}
+        scaler.evaluate_once()
+        assert sup.added == ["scale2"]
+
+
+class TestSupervisorElasticity:
+    def test_add_then_retire_replica_without_respawn(self, tmp_path):
+        state_path = str(tmp_path / "fleet-9999.json")
+        sleeper = ("-c", "import time; time.sleep(600)")
+        sup = FleetSupervisor(
+            [ReplicaSpec("r0", 0, sleeper)],
+            state_path,
+            router_port=9999,
+            poll_interval_s=0.05,
+        )
+        sup.start()
+        try:
+            sup.add_replica(ReplicaSpec("scale1", 0, sleeper))
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                reps = {r["id"]: r for r in sup.state()["replicas"]}
+                if reps.get("scale1", {}).get("alive"):
+                    break
+                time.sleep(0.05)
+            assert reps["scale1"]["alive"] is True
+            pid = reps["scale1"]["pid"]
+
+            assert sup.retire_replica("scale1") is True
+            # the spec is gone IMMEDIATELY — the monitor can never
+            # respawn a retired replica, even while it is still draining
+            assert [s.replica_id for s in sup.specs] == ["r0"]
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                if sup.retiring_count() == 0:
+                    break
+                time.sleep(0.05)
+            assert sup.retiring_count() == 0
+            with pytest.raises(ProcessLookupError):
+                os.kill(pid, 0)
+            # several monitor polls later: still exactly one replica
+            time.sleep(0.3)
+            assert [r["id"] for r in sup.state()["replicas"]] == ["r0"]
+            assert sup.retire_replica("ghost") is False
+        finally:
+            sup.stop()
+
+
+class _PromoteTarget:
+    """Stub router exposing just the two experiment endpoints."""
+
+    def __init__(self, variants, promote_status=200):
+        target = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def _json(self, status, payload):
+                raw = json.dumps(payload).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(raw)))
+                self.end_headers()
+                self.wfile.write(raw)
+
+            def do_GET(self):
+                if self.path == "/experiments.json":
+                    self._json(
+                        200,
+                        {"variants": [{"name": n} for n in target.variants]},
+                    )
+                    return
+                self._json(404, {})
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length") or 0)
+                body = json.loads(self.rfile.read(length) or b"{}")
+                if self.path == "/experiments/promote.json":
+                    target.promotions.append(body)
+                    self._json(
+                        target.promote_status,
+                        {"ok": True, "variant": body.get("variant")},
+                    )
+                    return
+                self._json(404, {})
+
+        self.variants = list(variants)
+        self.promote_status = promote_status
+        self.promotions: list[dict] = []
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.url = f"http://127.0.0.1:{self.server.server_address[1]}"
+        threading.Thread(target=self.server.serve_forever, daemon=True).start()
+
+    def close(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+class TestEvalPromotion:
+    def _result(self, n_params: int, best: int):
+        return types.SimpleNamespace(
+            best_index=best,
+            engine_params_scores=tuple(
+                (f"p{i}", f"s{i}") for i in range(n_params)
+            ),
+        )
+
+    def test_promotes_the_winning_variant_by_index(self):
+        from predictionio_tpu.tools.console import _promote_winner
+
+        target = _PromoteTarget(["champion", "challenger"])
+        try:
+            report = _promote_winner(target.url, self._result(2, best=1))
+        finally:
+            target.close()
+        assert report["promotedVariant"] == "challenger"
+        assert report["bestIndex"] == 1
+        assert target.promotions == [{"variant": "challenger"}]
+
+    def test_refuses_a_grid_experiment_cardinality_mismatch(self):
+        from predictionio_tpu.tools.console import _promote_winner
+
+        target = _PromoteTarget(["a", "b"])
+        try:
+            with pytest.raises(SystemExit):
+                _promote_winner(target.url, self._result(3, best=0))
+        finally:
+            target.close()
+        assert target.promotions == []
+
+    def test_unreachable_router_is_a_clean_error(self):
+        from predictionio_tpu.tools.console import _promote_winner
+
+        with pytest.raises(SystemExit):
+            _promote_winner(
+                "http://127.0.0.1:9", self._result(1, best=0)
+            )
+
+
+class TestStatusAggregation:
+    def test_registry_view_rows_warnings_and_fallback(self, tmp_path):
+        from predictionio_tpu.tools.commands import _endpoint_registry_status
+
+        lines: list[str] = []
+        # absent dir → degraded fallback (state files only)
+        assert (
+            _endpoint_registry_status(str(tmp_path / "nope"), lines.append)
+            is None
+        )
+        reg = EndpointRegistry(str(tmp_path), lease_ttl_s=60.0)
+        reg.announce("r0", "127.0.0.1", 9, generation=4)  # nothing listens
+        reg.announce("gone", "127.0.0.1", 9, now=time.time() - 300.0)
+        (tmp_path / "torn.endpoint.json").write_text("{oops")
+        view = _endpoint_registry_status(str(tmp_path), lines.append)
+        assert view["ring"] == ["r0"]
+        row = view["hosts"]["127.0.0.1"][0]
+        assert row["id"] == "r0"
+        assert row["generation"] == 4
+        assert row["ready"] is False  # probe refused: reported, not raised
+        assert row["leaseAgeS"] >= 0
+        assert view["staleLeases"] == ["gone"]
+        assert len(view["problems"]) == 1
+        text = "\n".join(lines)
+        assert "stale leases" in text
+        assert "torn registry entry" in text
