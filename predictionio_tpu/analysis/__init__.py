@@ -17,8 +17,11 @@ Rule families (docs/development.md):
 
 * ``PIO1xx`` layering — declarative import manifest (:mod:`manifest`)
 * ``PIO2xx`` concurrency — lock scope, blocking-under-lock, lock order
+  (whole-program: ``PIO206``–``PIO211`` over the cross-module callgraph)
 * ``PIO3xx`` JAX hygiene — host syncs inside jit, mutable jit closures
 * ``PIO4xx`` server hygiene — untimed sockets, bare excepts in handlers
+* ``PIO5xx`` crash consistency — the write→flush→fsync→rename protocol
+  on every durable root (:mod:`rules_durability`)
 
 This package is **stdlib-only and never imports the modules it lints**
 (AST text analysis only) — enforced by its own manifest entry, so the
@@ -45,6 +48,7 @@ from predictionio_tpu.analysis import rules_jax  # noqa: F401
 from predictionio_tpu.analysis import rules_server  # noqa: F401
 from predictionio_tpu.analysis import rules_program  # noqa: F401  (PIO206+)
 from predictionio_tpu.analysis import rules_compile  # noqa: F401  (PIO306+)
+from predictionio_tpu.analysis import rules_durability  # noqa: F401  (PIO501+)
 
 __all__ = [
     "DEFAULT_MANIFEST",

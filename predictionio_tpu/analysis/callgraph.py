@@ -421,12 +421,21 @@ class _Resolver:
         fi: FunctionInfo,
         local_types: dict[str, str],
     ) -> str | None:
-        """Global lock identity for a with-item, or None when unknowable.
-        ``self._lock`` -> ``module.Class._lock`` (declared-or-inherited
-        locks only); bare module-level names containing "lock" ->
-        ``module.NAME``; ``self.<attr>._lock``-style foreign locks and
-        arbitrary expressions stay anonymous."""
-        e = item.context_expr
+        return self._lock_id_expr(ctx, item.context_expr, fi, local_types)
+
+    def _lock_id_expr(
+        self,
+        ctx: FileContext,
+        e: ast.AST,
+        fi: FunctionInfo,
+        local_types: dict[str, str],
+    ) -> str | None:
+        """Global lock identity for a lock expression (a with-item's
+        context or the receiver of an explicit ``.acquire()``), or None
+        when unknowable. ``self._lock`` -> ``module.Class._lock``
+        (declared-or-inherited locks only); bare module-level names
+        containing "lock" -> ``module.NAME``; ``self.<attr>._lock``-style
+        foreign locks and arbitrary expressions stay anonymous."""
         attr = _self_attr(e)
         if attr is not None and fi.cls is not None:
             cq = f"{fi.module}.{fi.cls}"
@@ -485,9 +494,18 @@ class _Resolver:
                 tq = self._class_qname_for(ctx, tname)
                 if tq:
                     ci.attr_types[attr] = tq
-                else:
+                    continue
+                del ci.attr_types[attr]
+                # only a CLASS constructor of an unresolvable class is
+                # known-foreign (threading.Thread, http.client.*): the
+                # duck-typed fallback must stay available for attrs
+                # assigned from lowercase FACTORY calls (`self._pe =
+                # Storage.get_p_events()`) — their return type is simply
+                # unknown, and treating them as foreign hid every lock
+                # edge through the storage driver from the static graph
+                last = tname.rsplit(".", 1)[-1]
+                if last[:1].isupper():
                     ci.attr_foreign.add(attr)
-                    del ci.attr_types[attr]
 
     def resolve_file(self, ctx: FileContext) -> None:
         for fq, fi in self.graph.functions.items():
@@ -496,10 +514,16 @@ class _Resolver:
 
     def _local_types(
         self, ctx: FileContext, fi: FunctionInfo
-    ) -> dict[str, str]:
-        """name -> class qname for annotated params and constructor
-        assignments inside one function body."""
+    ) -> tuple[dict[str, str], dict[str, str]]:
+        """-> (name -> class qname, name -> aliased self attr) for one
+        function body: annotated params and constructor assignments in
+        the first map; bare ``svc = self.service`` aliases in the second
+        — so a method call through the alias resolves exactly like the
+        ``self.service.method()`` spelling (the alias idiom otherwise
+        hid whole call chains, and with them their lock edges, from the
+        static graph)."""
         out: dict[str, str] = {}
+        aliases: dict[str, str] = {}
         node = fi.node
         assert isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
         for a in (*node.args.posonlyargs, *node.args.args, *node.args.kwonlyargs):
@@ -509,13 +533,21 @@ class _Resolver:
             if tq:
                 out[a.arg] = tq
         for sub in ast.walk(node):
-            if isinstance(sub, ast.Assign) and isinstance(sub.value, ast.Call):
+            if not isinstance(sub, ast.Assign):
+                continue
+            if isinstance(sub.value, ast.Call):
                 tq = self._class_qname_for(ctx, _dotted(sub.value.func))
                 if tq:
                     for t in sub.targets:
                         if isinstance(t, ast.Name):
                             out.setdefault(t.id, tq)
-        return out
+                continue
+            battr = _self_attr(sub.value)
+            if battr is not None:
+                for t in sub.targets:
+                    if isinstance(t, ast.Name):
+                        aliases.setdefault(t.id, battr)
+        return out, aliases
 
     def _resolve_call(
         self,
@@ -523,8 +555,10 @@ class _Resolver:
         fi: FunctionInfo,
         call: ast.Call,
         local_types: dict[str, str],
+        local_aliases: dict[str, str] | None = None,
     ) -> tuple[tuple[str, ...], str | None]:
         """-> (internal callee qnames, external dotted name)."""
+        local_aliases = local_aliases or {}
         func = call.func
         # self.method()
         attr = _self_attr(func)
@@ -542,10 +576,19 @@ class _Resolver:
             if isinstance(base, ast.Name):
                 base_cls = local_types.get(base.id)
             battr = _self_attr(base)
+            if (
+                battr is None
+                and isinstance(base, ast.Name)
+                and base.id not in local_types
+            ):
+                # `svc = self.service; svc.method()` — the alias carries
+                # the self attr through, typed path and duck-typed
+                # fallback alike
+                battr = local_aliases.get(base.id)
             if battr is not None and fi.cls is not None:
                 own = self.graph.classes.get(f"{fi.module}.{fi.cls}")
                 if own is not None:
-                    base_cls = own.attr_types.get(battr)
+                    base_cls = own.attr_types.get(battr) or base_cls
             if base_cls:
                 target = self.graph.resolve_method(base_cls, func.attr)
                 if target:
@@ -576,12 +619,16 @@ class _Resolver:
                 if root_is_import and root not in ("self", "cls"):
                     return (), dotted
             # duck-typed hand-off (`self.service.apply_online_update()`
-            # where `service` was injected untyped): a method name
-            # defined by exactly one class in-package is unambiguous.
-            # Only for self-attributes of UNKNOWN origin — bare locals
-            # and attrs constructed from foreign classes (threads,
-            # sockets) are overwhelmingly stdlib objects — and never for
-            # ubiquitous protocol names.
+            # where `service` was injected untyped): treat every
+            # in-package method of that name as a may-call alternative,
+            # same bound as the self.<hook>() fallback above — requiring
+            # exactly one definition hid the whole storage-driver lock
+            # chain (two classes define tail_follow: the driver and its
+            # wrapper), which the runtime witness caught as analyzer
+            # gaps. Only for self-attributes of UNKNOWN origin — bare
+            # locals and attrs constructed from foreign classes
+            # (threads, sockets) are overwhelmingly stdlib objects — and
+            # never for ubiquitous protocol names.
             if (
                 battr is not None
                 and fi.cls is not None
@@ -590,8 +637,8 @@ class _Resolver:
                 own = self.graph.classes.get(f"{fi.module}.{fi.cls}")
                 if own is not None and battr not in own.attr_foreign:
                     hits = self.graph.methods_named(func.attr)
-                    if len(hits) == 1:
-                        return (hits[0],), None
+                    if 1 <= len(hits) <= 4:
+                        return tuple(hits), None
             return (), None
         if isinstance(func, ast.Name):
             resolved = ctx.import_map.get(func.id, func.id)
@@ -609,17 +656,76 @@ class _Resolver:
         return (), None
 
     def _resolve_function(self, ctx: FileContext, fi: FunctionInfo) -> None:
-        local_types = self._local_types(ctx, fi)
+        local_types, local_aliases = self._local_types(ctx, fi)
 
         def walk(node: ast.AST, held: tuple[str, ...], anon: int) -> None:
+            #: locks taken by an explicit `X.acquire()` STATEMENT among
+            #: this body's earlier children — held by every later sibling
+            #: (and its subtree) until a matching `X.release()` at the
+            #: same level. The `acquire(); try: ... finally: release()`
+            #: idiom thus marks the whole try as held, release included —
+            #: close enough to `with` semantics for ordering edges, and
+            #: the only way the router's _reload_lock is visible at all.
+            explicit: list[str] = []
             for child in ast.iter_child_nodes(node):
-                child_held = held
+                child_held = held + tuple(explicit)
                 child_anon = anon
                 if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
                     # nested defs run later, under their caller's locks —
                     # never under these (mirrors PIO201/202)
                     walk(child, (), 0)
                     continue
+                # `if not X.acquire(...): <bail>` — the try-acquire idiom:
+                # every path PAST the If holds the lock (the If body is
+                # the didn't-get-it bail, walked below without it)
+                if (
+                    isinstance(child, ast.If)
+                    and isinstance(child.test, ast.UnaryOp)
+                    and isinstance(child.test.op, ast.Not)
+                    and isinstance(child.test.operand, ast.Call)
+                    and isinstance(child.test.operand.func, ast.Attribute)
+                    and child.test.operand.func.attr == "acquire"
+                ):
+                    lid = self._lock_id_expr(
+                        ctx, child.test.operand.func.value, fi, local_types
+                    )
+                    if lid is not None:
+                        fi.acquisitions.append(
+                            LockAcquisition(
+                                lock_id=lid,
+                                line=child.lineno,
+                                held=child_held,
+                            )
+                        )
+                        explicit.append(lid)
+                        walk(child, child_held, child_anon)
+                        continue
+                call = None
+                if isinstance(
+                    child, (ast.Expr, ast.Assign)
+                ) and isinstance(child.value, ast.Call):
+                    call = child.value
+                if (
+                    call is not None
+                    and isinstance(call.func, ast.Attribute)
+                    and call.func.attr in ("acquire", "release")
+                ):
+                    lid = self._lock_id_expr(
+                        ctx, call.func.value, fi, local_types
+                    )
+                    if lid is not None:
+                        if call.func.attr == "acquire":
+                            fi.acquisitions.append(
+                                LockAcquisition(
+                                    lock_id=lid,
+                                    line=child.lineno,
+                                    held=child_held,
+                                )
+                            )
+                            explicit.append(lid)
+                        elif lid in explicit:
+                            explicit.remove(lid)
+                        continue  # the acquire/release call itself is no edge
                 if isinstance(child, ast.With):
                     acquired: list[str] = []
                     anon_acquired = 0
@@ -632,15 +738,15 @@ class _Resolver:
                     for lid in acquired:
                         fi.acquisitions.append(
                             LockAcquisition(
-                                lock_id=lid, line=child.lineno, held=held
+                                lock_id=lid, line=child.lineno, held=child_held
                             )
                         )
                     if acquired or anon_acquired:
-                        child_held = held + tuple(acquired)
+                        child_held = child_held + tuple(acquired)
                         child_anon = anon + anon_acquired
                 if isinstance(child, ast.Call):
                     callees, external = self._resolve_call(
-                        ctx, fi, child, local_types
+                        ctx, fi, child, local_types, local_aliases
                     )
                     if callees or external:
                         fi.calls.append(

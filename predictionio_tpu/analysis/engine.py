@@ -8,6 +8,12 @@ owns the cross-cutting mechanics every rule gets for free:
   baseline survives unrelated edits that shift line numbers);
 * inline suppressions — ``# piolint: disable=PIO201`` on the reported
   line, or ``# piolint: disable-file=PIO301`` anywhere in the file;
+* inline **waivers** — ``# piolint: waive=PIO501 -- reason text`` on the
+  reported line: like a disable, but the engine verifies the reason is
+  non-empty (``PIO001`` fires on a reasonless waiver, and the waived
+  code still fires too). Waivers are the sanctioned way to accept a
+  reviewed finding without growing the baseline, which is ratcheted to
+  only ever shrink (tests/test_ci_guards.py);
 * a checked-in JSON baseline (``piolint-baseline.json`` at the repo
   root): pre-existing, reviewed findings don't fail CI while any NEW
   finding does. Baseline entries match on (code, path, message) with a
@@ -59,6 +65,11 @@ _SKIP_DIRS = frozenset(
 
 _DISABLE_RE = re.compile(r"#\s*piolint:\s*disable=([A-Za-z0-9,\s]+)")
 _DISABLE_FILE_RE = re.compile(r"#\s*piolint:\s*disable-file=([A-Za-z0-9,\s]+)")
+#: ``# piolint: waive=PIO501 -- reviewed: cache file, rebuilt on boot``
+#: — group 1 is the code list, group 2 the (mandatory) reason text
+_WAIVE_RE = re.compile(
+    r"#\s*piolint:\s*waive=([A-Za-z0-9,\s]+?)\s*(?:--\s*(.*))?$"
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -251,10 +262,57 @@ class FileContext:
         line_codes = self.line_suppressions(f.line)
         return f.code in line_codes or "all" in line_codes
 
+    # -------------------------------------------------------------- waivers
+    def line_waivers(self, line: int) -> dict[str, str]:
+        """``{code: reason}`` for a ``# piolint: waive=...`` pragma on
+        ``line``, or on a comment-only line directly above it (for call
+        sites too long to carry an inline pragma) — reason may be empty,
+        which :func:`check_waiver_reasons` reports and :meth:`is_waived`
+        refuses to honor."""
+        if not (1 <= line <= len(self.lines)):
+            return {}
+        m = _WAIVE_RE.search(self.lines[line - 1])
+        if m is None and line >= 2:
+            above = self.lines[line - 2].strip()
+            if above.startswith("#"):
+                m = _WAIVE_RE.search(above)
+        if not m:
+            return {}
+        reason = (m.group(2) or "").strip()
+        return {
+            c.strip(): reason for c in m.group(1).split(",") if c.strip()
+        }
+
+    def is_waived(self, f: Finding) -> bool:
+        """True only for a waiver naming this finding's code WITH a
+        non-empty reason — a reasonless waiver does not waive (the
+        finding still fires, plus ``PIO001`` on the pragma itself)."""
+        return bool(self.line_waivers(f.line).get(f.code, "").strip())
+
 
 # ---------------------------------------------------------------------------
 # Running
 # ---------------------------------------------------------------------------
+
+
+@rule(
+    "PIO001",
+    "waiver-missing-reason",
+    "a `# piolint: waive=CODE` pragma carries no reason text",
+)
+def check_waiver_reasons(ctx: FileContext) -> Iterator[Finding]:
+    """The engine's own pragma hygiene: every waiver must say WHY. A
+    reasonless waiver is inert (the waived code still fires) and this
+    rule flags the pragma itself, so CI fails on both counts."""
+    for i, line in enumerate(ctx.lines, 1):
+        m = _WAIVE_RE.search(line)
+        if m and not (m.group(2) or "").strip():
+            yield ctx.finding(
+                "PIO001",
+                i,
+                "waiver pragma without a reason — write "
+                "`# piolint: waive=CODE -- <why this is acceptable>`",
+            )
 
 
 def _parse_failure(rel_path: str, e: SyntaxError) -> Finding:
@@ -279,7 +337,7 @@ def _lint_context(ctx: FileContext) -> tuple[list[Finding], int]:
         if r.program:
             continue  # program rules need the whole tree (lint_tree)
         for f in r.check(ctx):
-            if ctx.is_suppressed(f, file_codes):
+            if ctx.is_suppressed(f, file_codes) or ctx.is_waived(f):
                 suppressed += 1
             else:
                 kept.append(f)
@@ -356,7 +414,9 @@ def lint_sources(
             continue
         for f in r.check(program):
             ctx = contexts.get(f.path)
-            if ctx is not None and ctx.is_suppressed(f, file_codes[f.path]):
+            if ctx is not None and (
+                ctx.is_suppressed(f, file_codes[f.path]) or ctx.is_waived(f)
+            ):
                 suppressed += 1
             else:
                 findings.append(f)

@@ -1,4 +1,4 @@
-"""PIO206–PIO209 — whole-program concurrency rules.
+"""PIO206–PIO211 — whole-program concurrency rules.
 
 These are the interprocedural halves of the ``PIO2xx`` family: each one
 closes a blind spot a per-file rule demonstrably missed in review
@@ -11,12 +11,23 @@ hand). All four run over the :mod:`callgraph` built in
   held *reaches* ``time.sleep``/``urlopen``/``subprocess`` through the
   call graph. ``PIO202`` only sees the blocking call lexically inside
   the ``with`` block; the convoy is just as real three frames down.
-* ``PIO207`` cross-module lock-order cycle: the global lock-acquisition
-  digraph (lexical nesting + locks acquired by transitive callees while
-  another lock is held) contains a cycle whose locks span modules — the
-  QueryService↔batcher↔online-runner class of deadlock ``PIO203``'s
-  per-module view cannot represent. Cycles that live entirely inside one
-  module's lexical nesting are left to ``PIO203``.
+* ``PIO207`` cross-module lock-order cycle, **lexical edges only**: two
+  modules nest each other's locks in opposite orders, every acquisition
+  visible as a literal ``with`` nesting. Cycles inside one module's
+  lexical nesting are left to ``PIO203``; cycles needing at least one
+  call hop are ``PIO210``'s.
+* ``PIO210`` interprocedural lock-order cycle: the same global digraph,
+  but at least one edge of the ring only exists through the call graph
+  (router → registry → ring class of deadlock). The finding carries the
+  full call chain of every interprocedural edge — the provenance a
+  reviewer needs to decide whether the path is live.
+* ``PIO211`` durable syscall under a foreign lock: a call made while
+  holding a lock reaches ``os.fsync``/``os.replace``/``os.rename`` in a
+  function that does NOT own that lock — every thread contending for
+  the lock now waits on another component's disk flush (tens of ms per
+  sync on a busy volume). Syncing under one's OWN lock (the columnar
+  appender's single-writer contract) is deliberate and not flagged;
+  ``PIO206`` keeps its disjoint sleep/socket/subprocess primitive set.
 * ``PIO208`` deadline non-propagation: a function *receives* a
   deadline/timeout but calls a network primitive — or a package function
   that itself accepts a deadline — without forwarding any of it. The
@@ -43,7 +54,7 @@ from predictionio_tpu.analysis.callgraph import (
 from predictionio_tpu.analysis.engine import Finding, program_rule
 from predictionio_tpu.analysis.rules_concurrency import _BLOCKING_CALLS
 
-__all__ = ["lock_order_cycles"]
+__all__ = ["lock_order_cycles", "lock_order_edges"]
 
 #: reachability fuse: a deeper chain exists but the diagnostic is
 #: unreadable and the convoy is already proven by hop one
@@ -72,20 +83,22 @@ def _short(qname: str) -> str:
 # ---------------------------------------------------------------------------
 
 
-def _blocking_paths(graph: CallGraph) -> dict[str, tuple[str, tuple[str, ...]]]:
-    """For every function: the nearest blocking external call reachable
-    from its body, as ``(blocking_dotted, call_chain)`` where the chain
-    starts at the function itself. Bottom-up fixpoint — seed the direct
-    callers of a blocking primitive, then propagate shortest chains one
-    call hop per pass until stable. A memoized cut-on-recursion DFS is
-    wrong here: the value computed for a function while one of its
+def _call_paths(
+    graph: CallGraph, targets: frozenset[str]
+) -> dict[str, tuple[str, tuple[str, ...]]]:
+    """For every function: the nearest external call in ``targets``
+    reachable from its body, as ``(target_dotted, call_chain)`` where the
+    chain starts at the function itself. Bottom-up fixpoint — seed the
+    direct callers of a target primitive, then propagate shortest chains
+    one call hop per pass until stable. A memoized cut-on-recursion DFS
+    is wrong here: the value computed for a function while one of its
     (mutually) recursive peers was on-stack would be cached *without*
     the paths through that peer, permanently hiding convoys inside
     recursive call clusters."""
     paths: dict[str, tuple[str, tuple[str, ...]]] = {}
     for fq, fi in graph.functions.items():
         for site in fi.calls:
-            if site.external in _BLOCKING_CALLS:
+            if site.external in targets:
                 paths[fq] = (site.external, (fq,))
                 break
     # each pass extends chains by one hop; _MAX_CHAIN passes bound the
@@ -118,7 +131,7 @@ def _blocking_paths(graph: CallGraph) -> dict[str, tuple[str, tuple[str, ...]]]:
 )
 def check_transitive_blocking(program: ProgramContext) -> Iterator[Finding]:
     graph = program.graph
-    blocking = _blocking_paths(graph)
+    blocking = _call_paths(graph, _BLOCKING_CALLS)
     reported: set[tuple[str, str, str, str]] = set()
     for fq in sorted(graph.functions):
         fi = graph.functions[fq]
@@ -159,45 +172,66 @@ def check_transitive_blocking(program: ProgramContext) -> Iterator[Finding]:
 # ---------------------------------------------------------------------------
 
 
-def _locks_reachable(graph: CallGraph) -> dict[str, frozenset[str]]:
-    """Function qname -> every lock id acquired by it or any transitive
-    callee. Bottom-up fixpoint over the call graph (seed each function's
-    own acquisitions, union in callees' sets one hop per pass) — the
-    same reasoning as :func:`_blocking_paths`: a cut-on-recursion DFS
-    memoizes partial sets for members of recursive call clusters, losing
-    PIO207 edges through them."""
-    reach: dict[str, frozenset[str]] = {
-        fq: frozenset(a.lock_id for a in fi.acquisitions)
+def _lock_chains(
+    graph: CallGraph,
+) -> dict[str, dict[str, tuple[str, ...]]]:
+    """Function qname -> {lock id -> shortest call chain to an
+    acquisition of it}, where the chain starts at the function itself
+    and ends at the function that lexically acquires the lock. Bottom-up
+    fixpoint over the call graph (seed each function's own acquisitions,
+    extend callees' chains one hop per pass) — the same reasoning as
+    :func:`_call_paths`: a cut-on-recursion DFS memoizes partial sets
+    for members of recursive call clusters, losing PIO207/PIO210 edges
+    through them."""
+    reach: dict[str, dict[str, tuple[str, ...]]] = {
+        fq: {a.lock_id: (fq,) for a in fi.acquisitions}
         for fq, fi in graph.functions.items()
     }
     for _ in range(_MAX_CHAIN):
         changed = False
         for fq in graph.functions:
             fi = graph.functions[fq]
-            cur = reach[fq]
-            merged = cur
+            mine = reach[fq]
             for site in fi.calls:
                 for callee in site.callees:
-                    sub = reach.get(callee)
-                    if sub and not sub <= merged:
-                        merged = merged | sub
-            if merged is not cur:
-                reach[fq] = merged
-                changed = True
+                    # list(): a self-recursive callee aliases `mine`
+                    for lock, chain in list(reach.get(callee, {}).items()):
+                        cand = (fq,) + chain
+                        cur = mine.get(lock)
+                        if cur is None or len(cand) < len(cur):
+                            mine[lock] = cand
+                            changed = True
         if not changed:
             break
     return reach
 
 
+def _locks_reachable(graph: CallGraph) -> dict[str, frozenset[str]]:
+    """Function qname -> every lock id acquired by it or any transitive
+    callee (the chain-free view of :func:`_lock_chains`)."""
+    return {
+        fq: frozenset(chains) for fq, chains in _lock_chains(graph).items()
+    }
+
+
 def _lock_edges(program: ProgramContext) -> dict[tuple[str, str], dict]:
     """The global acquisition-order digraph: ``(outer, inner) ->
-    {path, line, kind}`` (first occurrence wins; lexical beats
-    interprocedural for attribution)."""
+    {path, line, kind, via, chain}`` (first occurrence wins; lexical
+    beats interprocedural for attribution). ``chain`` is the call chain
+    from the function holding ``outer`` to the function that acquires
+    ``inner`` — a single element for lexical edges."""
     graph = program.graph
-    reach = _locks_reachable(graph)
+    reach = _lock_chains(graph)
     edges: dict[tuple[str, str], dict] = {}
 
-    def add(outer: str, inner: str, fi: FunctionInfo, line: int, kind: str):
+    def add(
+        outer: str,
+        inner: str,
+        fi: FunctionInfo,
+        line: int,
+        kind: str,
+        chain: tuple[str, ...],
+    ):
         if outer == inner:
             return
         prev = edges.get((outer, inner))
@@ -207,22 +241,37 @@ def _lock_edges(program: ProgramContext) -> dict[tuple[str, str], dict]:
                 "line": line,
                 "kind": kind,
                 "via": fi.qname,
+                "chain": list(chain),
             }
 
     for fq in sorted(graph.functions):
         fi = graph.functions[fq]
         for acq in fi.acquisitions:
             for outer in acq.held:
-                add(outer, acq.lock_id, fi, acq.line, "lexical")
+                add(outer, acq.lock_id, fi, acq.line, "lexical", (fq,))
         for site in fi.calls:
             held = [h for h in site.held if h != "<lock>"]
             if not held:
                 continue
             for callee in site.callees:
-                for inner in sorted(reach.get(callee, ())):
+                for inner, chain in sorted(reach.get(callee, {}).items()):
                     for outer in held:
-                        add(outer, inner, fi, site.line, "interproc")
+                        add(
+                            outer, inner, fi, site.line, "interproc",
+                            (fq,) + chain,
+                        )
     return edges
+
+
+def lock_order_edges(program: ProgramContext) -> list[dict]:
+    """Every edge of the global lock-acquisition digraph, serialized for
+    the runtime witness crosscheck (:mod:`lock_witness`): a dynamically
+    observed acquisition order with no counterpart here is an analyzer
+    gap."""
+    return [
+        {"from": a, "to": b, **meta}
+        for (a, b), meta in sorted(_lock_edges(program).items())
+    ]
 
 
 def lock_order_cycles(program: ProgramContext) -> list[dict]:
@@ -262,11 +311,13 @@ def lock_order_cycles(program: ProgramContext) -> list[dict]:
 @program_rule(
     "PIO207",
     "cross-module-lock-cycle",
-    "lock-acquisition order forms a cycle across modules / call chains",
+    "lexically nested lock acquisitions form a cycle across modules",
 )
 def check_cross_module_lock_order(program: ProgramContext) -> Iterator[Finding]:
     for cyc in lock_order_cycles(program):
-        if cyc["lexical_only"] and len(cyc["modules"]) == 1:
+        if not cyc["lexical_only"]:
+            continue  # needs a call hop: PIO210's finding
+        if len(cyc["modules"]) == 1:
             continue  # PIO203's per-module lexical finding
         first = cyc["edges"][0]
         ctx = program.contexts.get(first["path"])
@@ -277,9 +328,116 @@ def check_cross_module_lock_order(program: ProgramContext) -> Iterator[Finding]:
             first["line"],
             "cross-module lock-order cycle: "
             + " -> ".join(_short(n) for n in cyc["cycle"])
-            + " (two code paths acquire these locks in opposite orders "
-            "across module/call boundaries: deadlock)",
+            + " (two modules nest these locks in opposite orders: "
+            "deadlock)",
         )
+
+
+@program_rule(
+    "PIO210",
+    "interprocedural-lock-cycle",
+    "lock-acquisition order forms a cycle through at least one "
+    "cross-function call chain",
+)
+def check_interprocedural_lock_order(
+    program: ProgramContext,
+) -> Iterator[Finding]:
+    """The whole-program half of the lock-order story: the ring only
+    closes through the call graph (a function holding lock A calls into
+    code that takes lock B, while another path nests them the other way
+    round). The full call chain of every interprocedural edge rides in
+    ``detail`` — chains are the provenance a reviewer needs, but they
+    are volatile under refactors, so the baseline key stays on the
+    ring itself."""
+    for cyc in lock_order_cycles(program):
+        if cyc["lexical_only"]:
+            continue  # PIO203/PIO207 territory
+        first = next(e for e in cyc["edges"] if e["kind"] == "interproc")
+        ctx = program.contexts.get(first["path"])
+        if ctx is None:
+            continue
+        chains = "; ".join(
+            f"{_short(e['from'])} -> {_short(e['to'])} via "
+            + " -> ".join(_short(c) for c in e.get("chain", ()))
+            for e in cyc["edges"]
+            if e["kind"] == "interproc"
+        )
+        yield ctx.finding(
+            "PIO210",
+            first["line"],
+            "interprocedural lock-order cycle: "
+            + " -> ".join(_short(n) for n in cyc["cycle"])
+            + " (two call paths acquire these locks in opposite orders: "
+            "deadlock needs only an unlucky schedule)",
+            detail=chains,
+        )
+
+
+# ---------------------------------------------------------------------------
+# PIO211 — durable syscall (fsync/rename) under a foreign lock
+# ---------------------------------------------------------------------------
+
+#: syscalls that publish bytes to disk — each one can stall for tens of
+#: milliseconds on a busy volume, which is a convoy when a lock the
+#: caller does not own is held across it. Disjoint from
+#: ``_BLOCKING_CALLS`` so PIO206 and PIO211 can never double-report.
+_DURABLE_SYSCALLS = frozenset(
+    {"os.fsync", "os.fdatasync", "os.replace", "os.rename"}
+)
+
+
+def _owner(dotted: str) -> str:
+    """``pkg.mod.Class.attr`` -> ``pkg.mod.Class`` (a lock's owning
+    class, or a function's owning class/module)."""
+    return dotted.rsplit(".", 1)[0]
+
+
+@program_rule(
+    "PIO211",
+    "durable-syscall-under-foreign-lock",
+    "a call made while holding a lock reaches os.fsync/os.replace/"
+    "os.rename in code that does not own the lock",
+)
+def check_durable_under_foreign_lock(
+    program: ProgramContext,
+) -> Iterator[Finding]:
+    graph = program.graph
+    durable = _call_paths(graph, _DURABLE_SYSCALLS)
+    reported: set[tuple[str, str, str, str]] = set()
+    for fq in sorted(graph.functions):
+        fi = graph.functions[fq]
+        for site in fi.calls:
+            held = [h for h in site.held if h != "<lock>"]
+            if not held:
+                continue
+            # (performing function, durable dotted, chain from here)
+            hits: list[tuple[str, str, tuple[str, ...]]] = []
+            if site.external in _DURABLE_SYSCALLS:
+                hits.append((fq, site.external, (fq,)))
+            for callee in site.callees:
+                path = durable.get(callee)
+                if path is not None:
+                    dotted, chain = path
+                    hits.append((chain[-1], dotted, (fq,) + chain))
+            for performer, dotted, chain in hits:
+                for lock in held:
+                    if _owner(lock) == _owner(performer):
+                        continue  # syncing under one's own lock: a choice
+                    key = (fq, lock, performer, dotted)
+                    if key in reported:
+                        continue
+                    reported.add(key)
+                    ctx = program.contexts[fi.rel_path]
+                    yield ctx.finding(
+                        "PIO211",
+                        site.line,
+                        f"call from {_short(fq)} while holding "
+                        f"{_short(lock)} reaches durable {dotted}() in "
+                        f"{_short(performer)}, which does not own that "
+                        "lock — every contender now waits on a foreign "
+                        "disk flush",
+                        detail="via " + " -> ".join(_short(c) for c in chain),
+                    )
 
 
 # ---------------------------------------------------------------------------

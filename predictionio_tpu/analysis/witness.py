@@ -54,6 +54,7 @@ from predictionio_tpu.analysis.callgraph import digraph_cycles
 __all__ = [
     "LockWitness",
     "active",
+    "build_program",
     "classify_static_cycles",
     "install",
     "report",
@@ -564,9 +565,12 @@ def classify_static_cycles(
     return out
 
 
-def static_lock_cycles(root: str | None = None) -> list[dict]:
-    """The static PIO207 cycle set for ``root`` (defaults to this
-    checkout), shared by ``pio tsan`` and the bench lint section."""
+def build_program(root: str | None = None):
+    """Parse ``root`` (defaults to this checkout) into the same
+    :class:`~predictionio_tpu.analysis.callgraph.ProgramContext` the
+    program-scope lint rules receive — the shared entry point for every
+    runtime-witness crosscheck (lock cycles here, the full lock-order
+    edge join in :mod:`predictionio_tpu.analysis.lock_witness`)."""
     from predictionio_tpu.analysis.engine import (
         FileContext,
         default_root,
@@ -577,7 +581,6 @@ def static_lock_cycles(root: str | None = None) -> list[dict]:
         ProgramContext,
         build_callgraph,
     )
-    from predictionio_tpu.analysis.rules_program import lock_order_cycles
 
     root = os.path.abspath(root or default_root())
     contexts: dict[str, FileContext] = {}
@@ -590,7 +593,15 @@ def static_lock_cycles(root: str | None = None) -> list[dict]:
         except SyntaxError:
             continue
     graph = build_callgraph(contexts)
-    return lock_order_cycles(ProgramContext(contexts, graph))
+    return ProgramContext(contexts, graph)
+
+
+def static_lock_cycles(root: str | None = None) -> list[dict]:
+    """The static PIO207/PIO210 cycle set for ``root`` (defaults to this
+    checkout), shared by ``pio tsan`` and the bench lint section."""
+    from predictionio_tpu.analysis.rules_program import lock_order_cycles
+
+    return lock_order_cycles(build_program(root))
 
 
 def run_with_witness(

@@ -60,6 +60,17 @@ __all__ = [
 _HISTORY_LIMIT = 50
 
 
+def _fsync_dir(directory: str) -> None:
+    """Make a just-renamed directory entry durable: without this, the
+    rename itself lives only in the page cache and a crash can forget
+    the file ever had its new name (PIO502)."""
+    fd = os.open(directory, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
 @dataclasses.dataclass(frozen=True)
 class RegistryRecord:
     """One published fleet generation."""
@@ -161,6 +172,7 @@ class ModelRegistry:
                 f.flush()
                 os.fsync(f.fileno())
             os.replace(tmp, self.path)
+            _fsync_dir(self.directory)
         finally:
             try:
                 os.unlink(tmp)
@@ -284,6 +296,7 @@ class EndpointRegistry:
                 json.dump(record.to_json(), f)
                 f.flush()
                 os.fsync(f.fileno())
+            # piolint: waive=PIO502 -- leases are ephemeral by contract: a crash-forgotten rename is indistinguishable from lease expiry, which every reader tolerates, and announce/heartbeat is the TTL/3 hot path where a per-beat dir fsync would tax the whole fleet
             os.replace(tmp, self._entry_path(replica_id))
         finally:
             try:

@@ -268,7 +268,18 @@ class FleetSupervisor:
         try:
             with os.fdopen(fd, "w") as f:
                 json.dump(doc, f, indent=2)
+                # full durability protocol (PIO501/PIO502): the state
+                # file is how a post-crash `pio status` finds orphaned
+                # replica PIDs to clean up — a torn or forgotten file
+                # after a host reset would leak the whole fleet
+                f.flush()
+                os.fsync(f.fileno())
             os.replace(tmp, self.state_path)
+            dfd = os.open(directory, os.O_RDONLY)
+            try:
+                os.fsync(dfd)
+            finally:
+                os.close(dfd)
         finally:
             try:
                 os.unlink(tmp)

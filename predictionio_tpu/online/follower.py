@@ -114,7 +114,17 @@ class TailFollower:
         tmp = self._path + ".tmp"
         with open(tmp, "w") as f:
             json.dump(pending, f)
+            # durability protocol (PIO501/PIO502): the watermark IS the
+            # exactly-once contract — a torn cursor file after a crash
+            # would re-deliver (or worse, skip) the whole tail
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(tmp, self._path)
+        dfd = os.open(os.path.dirname(self._path), os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
 
     def rollback(self) -> None:
         """Drop the un-committed poll advance: the next :meth:`poll`
@@ -136,6 +146,7 @@ class TailFollower:
         consecutive folds itself."""
         with self._lock:
             cursor = self._pending if self._pending is not None else self._cursor
+            # piolint: waive=PIO211 -- tail_follow can reach os.replace only on first-touch stream creation; every later poll is a pure delta read, and poll/commit must stay serialized under this lock regardless
             events, new_cursor = self._pe.tail_follow(
                 self._app_id,
                 self._channel_id,

@@ -205,6 +205,7 @@ class OnlineRunner:
     def _cycle(self, deadline: float | None = None) -> dict:
         with self._cycle_lock:
             try:
+                # piolint: waive=PIO211 -- the cycle lock exists to serialize fold cycles end to end; the watermark fsync MUST land before the next poll, and no request path ever contends on this lock
                 return self._cycle_locked(deadline)
             except Exception:
                 # the watermark must never advance past a batch that

@@ -844,6 +844,15 @@ def build_parser() -> argparse.ArgumentParser:
         "longer exists, without accepting anything new; CI fails on "
         "stale entries, this is the one-command cleanup",
     )
+    lint.add_argument(
+        "--witness", default=None, metavar="REPORT",
+        help="cross-check a recorded lock-witness report (pytest "
+        "--lock-witness or pio tsan JSON output) against the static "
+        "lock graph, both directions: a witnessed acquisition-order "
+        "edge missing from the static digraph (analyzer gap) or a "
+        "static cycle that neither manifested nor carries a "
+        "lock-witness-waivers.json entry fails the lint",
+    )
 
     # ---- tsan (runtime lock-witness: predictionio_tpu.analysis.witness)
     tsan = sub.add_parser(
@@ -1729,63 +1738,113 @@ def main(argv: list[str] | None = None) -> int:
             return subprocess.run(cmdline, env=env).returncode
         elif cmd == "lint":
             # stdlib-only AST analysis: imports nothing it lints, never
-            # initializes jax — safe and fast on any CI host
-            from predictionio_tpu.analysis import run_lint
+            # initializes jax — safe and fast on any CI host. Exit code
+            # contract (docs/development.md): 0 clean, 1 findings (or a
+            # failed witness crosscheck), 2 internal error — so a CI job
+            # can tell "the tree is dirty" from "the linter broke".
+            try:
+                from predictionio_tpu.analysis import run_lint
 
-            res = run_lint(
-                root=args.root,
-                baseline_path=args.baseline,
-                update_baseline=args.update_baseline,
-                prune_stale=args.prune_baseline,
-            )
-            pruned_ledger = 0
-            if args.prune_baseline:
-                # the compile-budget ledger prunes alongside the finding
-                # baseline: an entrypoint whose file/function is gone is
-                # the same class of stale debt (still stdlib-only — the
-                # prune is an AST existence check)
-                from predictionio_tpu.analysis import jit_witness
+                res = run_lint(
+                    root=args.root,
+                    baseline_path=args.baseline,
+                    update_baseline=args.update_baseline,
+                    prune_stale=args.prune_baseline,
+                )
+                pruned_ledger = 0
+                if args.prune_baseline:
+                    # the compile-budget ledger prunes alongside the
+                    # finding baseline: an entrypoint whose file or
+                    # function is gone is the same class of stale debt
+                    # (still stdlib-only — the prune is an AST existence
+                    # check)
+                    from predictionio_tpu.analysis import jit_witness
 
-                pruned_ledger = jit_witness.prune_ledger(
-                    jit_witness.default_ledger_path(res.root), res.root
-                )
-            if args.format == "json":
-                payload = res.to_json()
-                # the ledger prune rewrites a checked-in file; a CI job
-                # reading the JSON must see that happened, same as
-                # prunedBaselineEntries
-                payload["prunedCompileBudgetEntries"] = pruned_ledger
-                print(json.dumps(payload, indent=2))
-            elif args.format == "sarif":
-                print(json.dumps(res.to_sarif(), indent=2))
-            else:
-                for f in res.new_findings:
-                    print(f.render())
-                summary = (
-                    f"piolint: {res.files_scanned} files, "
-                    f"{len(res.new_findings)} new finding(s), "
-                    f"{len(res.baselined)} baselined, "
-                    f"{res.suppressed_count} suppressed"
-                )
-                if res.pruned_baseline:
-                    summary += (
-                        f", {res.pruned_baseline} stale baseline entr"
-                        f"{'y' if res.pruned_baseline == 1 else 'ies'} "
-                        "pruned"
+                    pruned_ledger = jit_witness.prune_ledger(
+                        jit_witness.default_ledger_path(res.root), res.root
                     )
-                if pruned_ledger:
-                    summary += (
-                        f", {pruned_ledger} stale compile-budget entr"
-                        f"{'y' if pruned_ledger == 1 else 'ies'} pruned"
+                xcheck = None
+                if args.witness:
+                    from predictionio_tpu.analysis import lock_witness
+
+                    with open(args.witness, encoding="utf-8") as fh:
+                        doc = json.load(fh)
+                    # accept any recorded shape: a pytest --lock-witness
+                    # / pio tsan payload ({"witness": {...}}) or a raw
+                    # witness report ({"edges": [...]})
+                    wrep = doc.get("witness", doc) if isinstance(
+                        doc, dict
+                    ) else {}
+                    xcheck = lock_witness.crosscheck(wrep, root=res.root)
+                ok = res.ok and (xcheck is None or xcheck["ok"])
+                if args.format == "json":
+                    payload = res.to_json()
+                    # the ledger prune rewrites a checked-in file; a CI
+                    # job reading the JSON must see that happened, same
+                    # as prunedBaselineEntries
+                    payload["prunedCompileBudgetEntries"] = pruned_ledger
+                    if xcheck is not None:
+                        payload["witnessCrosscheck"] = xcheck
+                        payload["ok"] = ok
+                    print(json.dumps(payload, indent=2))
+                elif args.format == "sarif":
+                    print(json.dumps(res.to_sarif(), indent=2))
+                else:
+                    for f in res.new_findings:
+                        print(f.render())
+                    summary = (
+                        f"piolint: {res.files_scanned} files, "
+                        f"{len(res.new_findings)} new finding(s), "
+                        f"{len(res.baselined)} baselined, "
+                        f"{res.suppressed_count} suppressed"
                     )
-                if res.stale_baseline:
-                    summary += (
-                        f", {res.stale_baseline} stale baseline entr"
-                        f"{'y' if res.stale_baseline == 1 else 'ies'} "
-                        "(fixed findings — prune with --prune-baseline)"
-                    )
-                print(summary)
-            return 0 if res.ok else 1
+                    if res.pruned_baseline:
+                        summary += (
+                            f", {res.pruned_baseline} stale baseline entr"
+                            f"{'y' if res.pruned_baseline == 1 else 'ies'} "
+                            "pruned"
+                        )
+                    if pruned_ledger:
+                        summary += (
+                            f", {pruned_ledger} stale compile-budget entr"
+                            f"{'y' if pruned_ledger == 1 else 'ies'} pruned"
+                        )
+                    if res.stale_baseline:
+                        summary += (
+                            f", {res.stale_baseline} stale baseline entr"
+                            f"{'y' if res.stale_baseline == 1 else 'ies'} "
+                            "(fixed findings — prune with --prune-baseline)"
+                        )
+                    print(summary)
+                    if xcheck is not None:
+                        print(
+                            f"lock-witness crosscheck: "
+                            f"{xcheck['dynamicEdges']} dynamic edge(s) vs "
+                            f"{xcheck['staticEdges']} static, "
+                            f"{len(xcheck['gaps'])} analyzer gap(s), "
+                            f"{len(xcheck['unwaivedStaticCycles'])} "
+                            f"unwaived static cycle(s), "
+                            f"{len(xcheck['staleWaivers'])} stale waiver(s)"
+                        )
+                        for g in xcheck["gaps"]:
+                            print(
+                                f"  GAP: witnessed {g['from']} -> "
+                                f"{g['to']} (x{g['count']}) has no static "
+                                f"edge {g['staticFrom']} -> {g['staticTo']}"
+                            )
+                        for c in xcheck["unwaivedStaticCycles"]:
+                            print(
+                                "  UNWAIVED CYCLE: "
+                                + " -> ".join(c["cycle"])
+                                + f" ({c['witnessedEdges']}/"
+                                f"{c['totalEdges']} edges witnessed; add "
+                                "a lock-witness-waivers.json entry or "
+                                "exercise it)"
+                            )
+                return 0 if ok else 1
+            except Exception as e:  # noqa: BLE001 — exit-code contract
+                print(f"piolint: internal error: {e}", file=sys.stderr)
+                return 2
         elif cmd == "tsan":
             # run a nested pio command in-process under the lock-witness
             # sanitizer (stdlib-only; docs/operations.md "Lock-witness
@@ -1817,10 +1876,13 @@ def main(argv: list[str] | None = None) -> int:
                         return 0
                     return code if isinstance(code, int) else 1
 
-            child_rc, rep = witness.run_with_witness(
-                run_child, long_hold_ms=args.long_hold_ms
+            from predictionio_tpu.analysis import lock_witness
+
+            child_rc, payload = lock_witness.run_with_lock_witness(
+                run_child,
+                long_hold_ms=args.long_hold_ms,
+                waivers=lock_witness.load_waivers(),
             )
-            payload = witness.tsan_report(rep)
             payload["command"] = cmdline
             payload["exitCode"] = child_rc
             if args.report:

@@ -39,10 +39,13 @@ def pytest_addoption(parser):
         "--lock-witness",
         action="store_true",
         default=False,
-        help="run the suite under the runtime lock-witness sanitizer "
-        "(predictionio_tpu.analysis.witness): records the lock "
-        "acquisition-order digraph and fails loudly on witnessed "
-        "lock-order inversions. Report lands at "
+        help="run the suite under the composed runtime lock/fsync "
+        "witness (predictionio_tpu.analysis.lock_witness): records the "
+        "lock acquisition-order digraph plus fsync/rename orderings, "
+        "fails loudly on witnessed lock-order inversions AND on a "
+        "failed static/dynamic crosscheck (a witnessed edge missing "
+        "from the static lock graph, or an unmanifested static cycle "
+        "without a lock-witness-waivers.json entry). Report lands at "
         "$PIO_LOCK_WITNESS_REPORT (JSON) or the terminal summary.",
     )
 
@@ -62,11 +65,15 @@ def pytest_addoption(parser):
 
 def pytest_configure(config):
     if config.getoption("--lock-witness"):
-        from predictionio_tpu.analysis import witness
+        from predictionio_tpu.analysis import lock_witness, witness
 
         # install BEFORE any test allocates a lock, so every
-        # object constructed during the run is witnessed
-        config._lock_witness = witness.install()
+        # object constructed during the run is witnessed; the composed
+        # witness adds the fsync/rename record on top of the lock half
+        w = lock_witness.LockFsyncWitness()
+        w.install()
+        witness._ACTIVE = w.locks  # witness.active()/report() still work
+        config._lock_witness = w
     if config.getoption("--jit-witness"):
         from predictionio_tpu.analysis import jit_witness
 
@@ -76,11 +83,19 @@ def pytest_configure(config):
 
 
 def pytest_sessionfinish(session, exitstatus):
-    # "fails loudly": a witnessed lock-order inversion turns a green run
-    # red even though no individual test asserted on it — the sanitizer
-    # is only worth running if its findings gate CI
+    # "fails loudly": a witnessed lock-order inversion OR a failed
+    # static/dynamic crosscheck turns a green run red even though no
+    # individual test asserted on it — the sanitizer is only worth
+    # running if its findings gate CI. The full payload (crosscheck
+    # included) is computed once here and stashed for unconfigure.
     w = getattr(session.config, "_lock_witness", None)
-    if w is not None and exitstatus == 0 and w.report().get("inversions"):
+    if w is None:
+        return
+    from predictionio_tpu.analysis import lock_witness
+
+    payload = lock_witness.lockwitness_report(w.report())
+    session.config._lock_witness_payload = payload
+    if exitstatus == 0 and not payload["ok"]:
         session.exitstatus = 3
 
 
@@ -119,11 +134,14 @@ def pytest_unconfigure(config):
         return
     import json as _json
 
-    from predictionio_tpu.analysis import witness
+    from predictionio_tpu.analysis import lock_witness, witness
 
-    witness.uninstall()
-    rep = w.report()
-    payload = witness.tsan_report(rep)
+    w.uninstall()
+    witness._ACTIVE = None
+    payload = getattr(config, "_lock_witness_payload", None)
+    if payload is None:  # sessionfinish never ran (collection crash)
+        payload = lock_witness.lockwitness_report(w.report())
+    rep = payload["witness"]
     path = os.environ.get("PIO_LOCK_WITNESS_REPORT")
     if path:
         witness.write_report(path, payload)
@@ -131,15 +149,28 @@ def pytest_unconfigure(config):
     confirmed = [
         c for c in payload["staticLockCycles"] if c["status"] == "CONFIRMED"
     ]
+    cc = payload["crosscheck"]
+    fs = rep.get("fsync", {})
     print(
         f"\nlock-witness: {len(rep.get('locks', {}))} lock site(s), "
         f"{len(rep.get('edges', []))} order edge(s), "
         f"{len(inv)} inversion(s), "
         f"{len(payload['staticLockCycles'])} static cycle(s) "
-        f"({len(confirmed)} CONFIRMED)"
+        f"({len(confirmed)} CONFIRMED); "
+        f"fsync: {fs.get('fsyncCalls', 0)} call(s), "
+        f"{len(fs.get('renames', []))} rename(s); "
+        f"crosscheck: {len(cc['gaps'])} gap(s), "
+        f"{len(cc['unwaivedStaticCycles'])} unwaived cycle(s), "
+        f"{len(cc['staleWaivers'])} stale waiver(s)"
     )
     if inv:
         print(_json.dumps(inv, indent=2))
+    if cc["gaps"] or cc["unwaivedStaticCycles"]:
+        print(_json.dumps(
+            {"gaps": cc["gaps"],
+             "unwaivedStaticCycles": cc["unwaivedStaticCycles"]},
+            indent=2,
+        ))
 
 
 @pytest.fixture()

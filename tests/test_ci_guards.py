@@ -807,6 +807,18 @@ def test_lock_witness_over_tier1_concurrency_suites():
     assert payload["ok"] is True
     for cyc in payload["staticLockCycles"]:
         assert cyc["status"] in ("CONFIRMED", "PLAUSIBLE"), cyc
+    # ISSUE 18 regression bar: every acquisition order the witness saw
+    # while the real concurrency suites ran must be an edge the static
+    # lock graph already knows — a gap means callgraph.py lost a call
+    # path the runtime actually takes
+    cc = payload["crosscheck"]
+    assert cc["gaps"] == [], (
+        "dynamically witnessed lock order(s) missing from the static "
+        "graph:\n" + json.dumps(cc["gaps"], indent=2)
+    )
+    assert cc["unwaivedStaticCycles"] == [], cc["unwaivedStaticCycles"]
+    assert cc["staleWaivers"] == [], cc["staleWaivers"]
+    assert cc["dynamicEdges"] > 0, "witness saw no acquisition orders"
 
 
 def test_bench_smoke_runs_green():
@@ -1367,3 +1379,36 @@ def test_bench_smoke_runs_green():
     assert lint["rules"] >= 20, (
         f"rule registry shrank — PIO306-308 may have fallen out: {lint}"
     )
+
+
+def test_piolint_baseline_only_ratchets_down():
+    """piolint-baseline.json is a one-way ratchet (ISSUE 18): relative
+    to the committed copy, entries may only ever be REMOVED. A new
+    finding is fixed or waived in source with a reason (`# piolint:
+    waive=CODE -- why`, verified by PIO001) — never re-baselined.
+    (Zero non-baselined findings on the real tree is asserted by
+    test_full_tree_lints_clean_and_fast.)"""
+    path = os.path.join(REPO, "piolint-baseline.json")
+    with open(path, encoding="utf-8") as fh:
+        working = json.load(fh)
+    proc = subprocess.run(
+        ["git", "show", "HEAD:piolint-baseline.json"],
+        cwd=REPO, capture_output=True, text=True, timeout=30,
+    )
+    if proc.returncode != 0:
+        pytest.skip("no committed baseline to ratchet against")
+    committed = json.loads(proc.stdout)
+
+    def keys(doc):
+        return {
+            json.dumps(e, sort_keys=True) for e in doc.get("entries", [])
+        }
+
+    grew = keys(working) - keys(committed)
+    assert not grew, (
+        "the baseline only ratchets down — fix or waive these instead "
+        "of re-baselining:\n" + "\n".join(sorted(grew))
+    )
+    # the other half of the ratchet — zero NON-baselined findings on the
+    # real tree — is test_full_tree_lints_clean_and_fast's assertion;
+    # duplicating the ~6 s whole-program lint here would buy nothing

@@ -499,9 +499,16 @@ class TestSupervisor:
                     break
                 time.sleep(0.05)
             assert new_pid is not None, "supervisor never respawned the replica"
-            # the state FILE is what operators and the chaos drill read
-            with open(state_path) as f:
-                on_disk = json.load(f)
+            # the state FILE is what operators and the chaos drill read;
+            # it is rewritten (durably: fsync + dir fsync) just after the
+            # in-memory flip, so poll it within the same deadline
+            on_disk = None
+            while time.monotonic() < deadline:
+                with open(state_path) as f:
+                    on_disk = json.load(f)
+                if on_disk["replicas"][0]["pid"] == new_pid:
+                    break
+                time.sleep(0.05)
             assert on_disk["replicas"][0]["pid"] == new_pid
         finally:
             sup.stop()

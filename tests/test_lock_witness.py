@@ -1,0 +1,416 @@
+"""Runtime lock/fsync witness + static/dynamic crosscheck (ISSUE 18).
+
+Covers the composed witness's fsync/rename record, the two-way
+crosscheck (a witnessed acquisition order missing from the static lock
+graph fails the run; a static cycle that never manifests needs an
+explicit waiver), waiver-file hygiene, and one end-to-end regression
+over the real fleet workload: every dynamically observed acquisition
+order must be an edge the static analyzer already knows about.
+"""
+
+import json
+import os
+import textwrap
+
+from predictionio_tpu.analysis.callgraph import (
+    ProgramContext,
+    build_callgraph,
+)
+from predictionio_tpu.analysis.engine import FileContext
+from predictionio_tpu.analysis.lock_witness import (
+    FsyncWitness,
+    crosscheck,
+    load_waivers,
+    run_with_lock_witness,
+)
+from predictionio_tpu.analysis.manifest import DEFAULT_MANIFEST
+
+
+def _program(files):
+    contexts = {
+        p: FileContext(p, textwrap.dedent(s), DEFAULT_MANIFEST)
+        for p, s in files.items()
+    }
+    return ProgramContext(contexts, build_callgraph(contexts))
+
+
+# ---------------------------------------------------------------------------
+# FsyncWitness: the durability half
+# ---------------------------------------------------------------------------
+
+
+def test_fsync_witness_records_protocol(tmp_path):
+    """A full write->fsync->rename->dir-fsync publish is recorded with
+    srcFsynced AND dirFsynced; a fsyncless rename lands in the
+    renamesWithoutFsync bucket."""
+    w = FsyncWitness()
+    w.install()
+    try:
+        good = tmp_path / "state.json"
+        tmp = tmp_path / "state.json.tmp"
+        with open(tmp, "w") as f:
+            f.write("{}")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, good)
+        dfd = os.open(tmp_path, os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+
+        bad = tmp_path / "torn.json"
+        with open(str(bad) + ".tmp", "w") as f:
+            f.write("{}")
+        os.replace(str(bad) + ".tmp", bad)
+    finally:
+        w.uninstall()
+    rep = w.report()
+    assert rep["fsyncCalls"] >= 2  # file fd + directory fd
+    assert len(rep["renames"]) == 2
+    by_dst = {r["dst"]: r for r in rep["renames"]}
+    durable = by_dst[os.path.realpath(good)]
+    assert durable["srcFsynced"] and durable["dirFsynced"]
+    torn = by_dst[os.path.realpath(bad)]
+    assert not torn["srcFsynced"]
+    assert [r["dst"] for r in rep["renamesWithoutFsync"]] == [
+        os.path.realpath(bad)
+    ]
+    # uninstall really hands the real os functions back (the wrappers
+    # are plain Python functions; the originals are builtins)
+    assert os.fsync.__module__ in ("posix", "nt", "os")
+    assert os.replace.__module__ in ("posix", "nt", "os")
+
+
+# ---------------------------------------------------------------------------
+# Crosscheck direction 1: dynamic edge -> static graph (analyzer gaps)
+# ---------------------------------------------------------------------------
+
+_GAP_SOURCES = {
+    "predictionio_tpu/m1.py": """\
+    import threading
+
+    class A:
+        def __init__(self):
+            self._a_lock = threading.Lock()
+            self._b_lock = threading.Lock()
+
+        def both(self):
+            with self._a_lock:
+                with self._b_lock:
+                    pass
+
+    class C:
+        def __init__(self):
+            self._c_lock = threading.Lock()
+
+        def solo(self):
+            with self._c_lock:
+                pass
+    """,
+}
+
+
+def _rep(edges):
+    return {"edges": edges, "inversions": [], "locks": {}}
+
+
+def test_crosscheck_witnessed_edge_with_static_analog_passes():
+    program = _program(_GAP_SOURCES)
+    cc = crosscheck(
+        _rep([{"from": "A._a_lock", "to": "A._b_lock", "count": 3}]),
+        waivers=[],
+        program=program,
+    )
+    assert cc["ok"]
+    assert cc["gaps"] == [] and cc["unmappedEdges"] == []
+    assert cc["dynamicEdges"] == 1 and cc["staticEdges"] >= 1
+
+
+def test_crosscheck_gap_fails_the_run():
+    """A witnessed order between two statically-KNOWN locks that the
+    static digraph lacks is an analyzer gap — the whole point of the
+    witness — and fails the run."""
+    program = _program(_GAP_SOURCES)
+    cc = crosscheck(
+        _rep([{"from": "C._c_lock", "to": "A._a_lock", "count": 7}]),
+        waivers=[],
+        program=program,
+    )
+    assert not cc["ok"]
+    assert len(cc["gaps"]) == 1
+    gap = cc["gaps"][0]
+    assert gap["count"] == 7
+    assert gap["staticFrom"] == "predictionio_tpu.m1.C._c_lock"
+    assert gap["staticTo"] == "predictionio_tpu.m1.A._a_lock"
+
+
+def test_crosscheck_unattributable_edges_never_prove_gaps():
+    """Sites the witness could not name statically (path:line fallback,
+    unknown short names, ambiguous short names) land in unmappedEdges —
+    the gate never fires on evidence it cannot attribute."""
+    ambiguous = dict(_GAP_SOURCES)
+    ambiguous["predictionio_tpu/m2.py"] = textwrap.dedent("""\
+    import threading
+
+    class A:
+        def __init__(self):
+            self._a_lock = threading.Lock()
+
+        def solo(self):
+            with self._a_lock:
+                pass
+    """)
+    program = _program(ambiguous)
+    cc = crosscheck(
+        _rep([
+            {"from": "scratch.py:12", "to": "A._b_lock", "count": 1},
+            {"from": "Z._z_lock", "to": "A._b_lock", "count": 1},
+            {"from": "A._a_lock", "to": "A._b_lock", "count": 1},
+        ]),
+        waivers=[],
+        program=program,
+    )
+    assert cc["ok"] and cc["gaps"] == []
+    whys = sorted(e["why"] for e in cc["unmappedEdges"])
+    assert whys == [
+        "ambiguous-short-name", "anonymous-site", "unknown-to-static"
+    ]
+
+
+# ---------------------------------------------------------------------------
+# Crosscheck direction 2: static cycle -> dynamic manifestation / waiver
+# ---------------------------------------------------------------------------
+
+_CYCLE_SOURCES = {
+    "predictionio_tpu/m1.py": """\
+    import threading
+
+    class A:
+        def __init__(self, other):
+            self._a_lock = threading.Lock()
+            self.other = other
+
+        def one(self):
+            with self._a_lock:
+                self.other.poke()
+
+        def fold_hot_rows(self):
+            with self._a_lock:
+                pass
+    """,
+    "predictionio_tpu/m2.py": """\
+    import threading
+
+    class Other:
+        def __init__(self, owner):
+            self._b_lock = threading.Lock()
+            self.owner = owner
+
+        def poke(self):
+            with self._b_lock:
+                pass
+
+        def two(self):
+            with self._b_lock:
+                self.owner.fold_hot_rows()
+    """,
+}
+
+_CYCLE_PAIRS = [
+    {"from": "A._a_lock", "to": "Other._b_lock", "count": 1},
+    {"from": "Other._b_lock", "to": "A._a_lock", "count": 1},
+]
+
+
+def _the_cycle(program):
+    from predictionio_tpu.analysis.rules_program import lock_order_cycles
+
+    cycles = lock_order_cycles(program)
+    assert len(cycles) == 1
+    return cycles[0]["cycle"]
+
+
+def test_crosscheck_unmanifested_static_cycle_needs_waiver():
+    program = _program(_CYCLE_SOURCES)
+    cycle = _the_cycle(program)
+    # no waiver, never witnessed: fails
+    cc = crosscheck(_rep([]), waivers=[], program=program)
+    assert not cc["ok"]
+    assert len(cc["unwaivedStaticCycles"]) == 1
+    un = cc["unwaivedStaticCycles"][0]
+    assert un["cycle"] == cycle
+    assert un["witnessedEdges"] == 0 and un["totalEdges"] == 2
+    # an explicit waiver with a reason turns the run green
+    waiver = [{"cycle": cycle, "reason": "paths proven mutually exclusive"}]
+    cc = crosscheck(_rep([]), waivers=waiver, program=program)
+    assert cc["ok"]
+    assert cc["unwaivedStaticCycles"] == [] and cc["staleWaivers"] == []
+    assert cc["waivedStaticCycles"] == [
+        {"cycle": cycle, "reason": "paths proven mutually exclusive"}
+    ]
+
+
+def test_crosscheck_manifested_cycle_needs_no_waiver_and_stales_one():
+    """A static cycle whose every edge was witnessed at runtime is a
+    real bug the workload exercises — it needs no waiver, and a waiver
+    claiming it can't happen is flagged stale."""
+    program = _program(_CYCLE_SOURCES)
+    cycle = _the_cycle(program)
+    cc = crosscheck(_rep(list(_CYCLE_PAIRS)), waivers=[], program=program)
+    assert cc["unwaivedStaticCycles"] == []
+    assert cc["ok"]  # crosscheck passes; the INVERSION gate catches it
+    cc = crosscheck(
+        _rep(list(_CYCLE_PAIRS)),
+        waivers=[{"cycle": cycle, "reason": "cannot happen"}],
+        program=program,
+    )
+    assert len(cc["staleWaivers"]) == 1
+    assert cc["staleWaivers"][0]["cycle"] == cycle
+
+
+def test_crosscheck_waiver_for_vanished_cycle_is_stale():
+    program = _program(_GAP_SOURCES)  # no cycles at all
+    cc = crosscheck(
+        _rep([]),
+        waivers=[{"cycle": ["x", "y", "x"], "reason": "old"}],
+        program=program,
+    )
+    assert cc["ok"]
+    assert cc["staleWaivers"] == [{"cycle": ["x", "y", "x"], "reason": "old"}]
+
+
+def test_load_waivers_requires_reason(tmp_path):
+    p = tmp_path / "lock-witness-waivers.json"
+    p.write_text(json.dumps({
+        "version": 1,
+        "cycles": [
+            {"cycle": ["a", "b", "a"], "reason": "  justified  "},
+            {"cycle": ["c", "d", "c"], "reason": "   "},
+            {"cycle": ["e", "f", "e"]},
+            {"not": "a waiver"},
+        ],
+    }))
+    out = load_waivers(str(p))
+    assert out == [{"cycle": ["a", "b", "a"], "reason": "justified"}]
+    assert load_waivers(str(tmp_path / "missing.json")) == []
+
+
+def test_repo_waiver_file_is_well_formed():
+    """The checked-in waivers file parses, and every entry it ever
+    grows must carry a non-empty reason (load_waivers drops the rest —
+    this asserts nothing is silently dropped)."""
+    path = os.path.join(os.path.dirname(__file__), "..",
+                        "lock-witness-waivers.json")
+    with open(path, encoding="utf-8") as fh:
+        doc = json.load(fh)
+    assert doc.get("version") == 1
+    assert load_waivers(path) == [
+        {"cycle": [str(n) for n in e["cycle"]],
+         "reason": str(e["reason"]).strip()}
+        for e in doc.get("cycles", [])
+    ]
+
+
+# ---------------------------------------------------------------------------
+# End-to-end regression: the real fleet workload under the witness
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_workload_every_dynamic_edge_is_a_static_edge(tmp_path):
+    """ISSUE 18's acceptance bar: drive the real replica/registry code
+    under the composed witness and assert every dynamically observed
+    acquisition order is an edge the static analyzer already knows
+    (zero crosscheck gaps), and the registry publish runs the full
+    durability protocol (fsync'd source AND parent directory)."""
+    from predictionio_tpu.fleet.registry import ModelRegistry
+    from predictionio_tpu.fleet.router import ReplicaState, RouterConfig
+
+    def workload():
+        r = ReplicaState("r0", "127.0.0.1", 1234, RouterConfig())
+        r.note_success(generation=1)
+        r.to_json()
+        reg = ModelRegistry(str(tmp_path))
+        reg.publish("inst-1", meta={"models": 1})
+        return reg.current()
+
+    record, payload = run_with_lock_witness(workload, waivers=[])
+    assert record is not None and record.generation == 1
+
+    rep = payload["witness"]
+    witnessed = {(e["from"], e["to"]) for e in rep["edges"]}
+    assert ("ReplicaState._lock", "CircuitBreaker._lock") in witnessed
+    assert rep["inversions"] == []
+
+    cc = payload["crosscheck"]
+    assert cc["gaps"] == [], (
+        "the witness observed a lock order the static graph lacks — "
+        "teach callgraph.py the path:\n" + json.dumps(cc["gaps"], indent=2)
+    )
+    assert cc["unwaivedStaticCycles"] == []
+    assert payload["ok"]
+
+    # the publish rename ran the full protocol
+    registry_path = os.path.realpath(tmp_path / "model-registry.json")
+    renames = [
+        r for r in rep["fsync"]["renames"] if r["dst"] == registry_path
+    ]
+    assert renames, "registry publish rename was not witnessed"
+    assert all(r["srcFsynced"] and r["dirFsynced"] for r in renames)
+
+
+# ---------------------------------------------------------------------------
+# CLI: pio lint --witness
+# ---------------------------------------------------------------------------
+
+
+def test_pio_lint_witness_cli(tmp_path):
+    """`pio lint --witness REPORT` joins a recorded witness run against
+    the static graph of --root: an analyzer gap flips the exit code to
+    1 and names both the dynamic and the static side."""
+    import subprocess
+    import sys
+
+    repo = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+    pkg = tmp_path / "predictionio_tpu"
+    pkg.mkdir()
+    (pkg / "m1.py").write_text(
+        textwrap.dedent(_GAP_SOURCES["predictionio_tpu/m1.py"])
+    )
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    base = [
+        sys.executable, "-m", "predictionio_tpu.tools.console",
+        "lint", "--root", str(tmp_path),
+    ]
+
+    ok_report = tmp_path / "ok.json"
+    ok_report.write_text(json.dumps(
+        {"witness": _rep(
+            [{"from": "A._a_lock", "to": "A._b_lock", "count": 2}]
+        )}
+    ))
+    proc = subprocess.run(
+        base + ["--witness", str(ok_report)],
+        capture_output=True, text=True, timeout=120, env=env, cwd=repo,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "0 analyzer gap(s)" in proc.stdout
+
+    gap_report = tmp_path / "gap.json"
+    gap_report.write_text(json.dumps(
+        {"witness": _rep(
+            [{"from": "C._c_lock", "to": "A._a_lock", "count": 5}]
+        )}
+    ))
+    proc = subprocess.run(
+        base + ["--witness", str(gap_report), "--format", "json"],
+        capture_output=True, text=True, timeout=120, env=env, cwd=repo,
+    )
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)
+    assert doc["ok"] is False
+    assert doc["witnessCrosscheck"]["gaps"][0]["staticFrom"] == (
+        "predictionio_tpu.m1.C._c_lock"
+    )

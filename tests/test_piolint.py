@@ -517,15 +517,23 @@ class Other:
 """
 
 
-def test_pio207_cross_module_lock_cycle():
+def test_pio210_interprocedural_lock_cycle():
+    """A cycle that needs the callgraph to see (locks nested through
+    CALLS, not lexically) is PIO210's finding, with full call-chain
+    provenance in the rendered detail."""
     files = {
         "predictionio_tpu/m1.py": _PIO207_M1,
         "predictionio_tpu/m2.py": _PIO207_M2,
     }
     found = _program_find(files)
-    assert [f.code for f in found] == ["PIO207"]
-    assert "A._a_lock" in found[0].message
-    assert "Other._b_lock" in found[0].message
+    assert [f.code for f in found] == ["PIO210"]
+    f = found[0]
+    assert "A._a_lock" in f.message
+    assert "Other._b_lock" in f.message
+    # the call chains are render-only provenance, never in the baseline
+    # key: a refactor that re-routes the path must not churn the baseline
+    assert "one" in f.render() and "poke" in f.render()
+    assert "via" not in f.message
     # consistent order (break the back edge): no cycle
     consistent = dict(files)
     consistent["predictionio_tpu/m2.py"] = _PIO207_M2.replace(
@@ -533,7 +541,7 @@ def test_pio207_cross_module_lock_cycle():
         "        self.owner.fold_hot_rows()",
     )
     assert _program_codes(consistent) == []
-    # a per-module LEXICAL cycle stays PIO203's finding, not PIO207's
+    # a per-module LEXICAL cycle stays PIO203's finding, not PIO210's
     lexical = {
         "predictionio_tpu/solo.py": """\
         import threading
@@ -557,12 +565,70 @@ def test_pio207_cross_module_lock_cycle():
     assert _program_codes(lexical) == ["PIO203"]
 
 
-def test_pio207_suppression():
+_PIO207_LOCKS = """\
+import threading
+
+INGEST_LOCK = threading.Lock()
+FLUSH_LOCK = threading.Lock()
+"""
+
+_PIO207_LEX1 = """\
+from predictionio_tpu.locks import INGEST_LOCK, FLUSH_LOCK
+
+def one():
+    with INGEST_LOCK:
+        with FLUSH_LOCK:
+            pass
+"""
+
+_PIO207_LEX2 = """\
+from predictionio_tpu.locks import INGEST_LOCK, FLUSH_LOCK
+
+def two():
+    with FLUSH_LOCK:
+        with INGEST_LOCK:
+            pass
+"""
+
+
+def test_pio207_lexical_cross_module_cycle():
+    """PIO207 keeps the purely LEXICAL cross-module cycles: two modules
+    visibly nest shared module-level locks in opposite orders — no
+    callgraph needed, but no single module shows the inversion either
+    (PIO203 is per-module and stays silent)."""
     files = {
-        "predictionio_tpu/m1.py": _PIO207_M1 + "\n# piolint: disable-file=PIO207\n",
+        "predictionio_tpu/locks.py": _PIO207_LOCKS,
+        "predictionio_tpu/lex1.py": _PIO207_LEX1,
+        "predictionio_tpu/lex2.py": _PIO207_LEX2,
+    }
+    found = _program_find(files)
+    assert [f.code for f in found] == ["PIO207"]
+    assert "INGEST_LOCK" in found[0].message
+    assert "FLUSH_LOCK" in found[0].message
+    # consistent nesting across both modules: clean
+    consistent = dict(files)
+    consistent["predictionio_tpu/lex2.py"] = _PIO207_LEX2.replace(
+        "    with FLUSH_LOCK:\n        with INGEST_LOCK:",
+        "    with INGEST_LOCK:\n        with FLUSH_LOCK:",
+    )
+    assert _program_codes(consistent) == []
+
+
+def test_pio207_pio210_suppression():
+    files = {
+        "predictionio_tpu/m1.py": _PIO207_M1 + "\n# piolint: disable-file=PIO210\n",
         "predictionio_tpu/m2.py": _PIO207_M2,
     }
     assert _program_codes(files) == []
+    lex = {
+        "predictionio_tpu/locks.py": _PIO207_LOCKS,
+        "predictionio_tpu/lex1.py": _PIO207_LEX1,
+        # the finding anchors at the edge that closes the cycle (lex2)
+        "predictionio_tpu/lex2.py": (
+            _PIO207_LEX2 + "\n# piolint: disable-file=PIO207\n"
+        ),
+    }
+    assert _program_codes(lex) == []
 
 
 def test_lock_order_cycles_structured_output():
@@ -1208,15 +1274,29 @@ class Models:
 def test_pio403_fsyncless_replace():
     # the exact pattern satellite 1 fixed in localfs.py
     assert _codes("predictionio_tpu/data/storage/x.py", _FSYNCLESS) == ["PIO403"]
-    # scoped to data/storage/: elsewhere atomic-replace without fsync is
-    # a judgment call, not a durability contract
-    assert _codes("predictionio_tpu/api/x.py", _FSYNCLESS) == []
-    # an os.fsync between write and replace satisfies the rule
+    # outside data/storage/ the same pattern is PIO501's finding (the
+    # crash-consistency family owns it there) — exactly one of the two
+    # rules fires per site, never both
+    assert _codes("predictionio_tpu/api/x.py", _FSYNCLESS) == ["PIO501"]
+    # an os.fsync between write and replace satisfies PIO403, but the
+    # crash-consistency layer still wants the parent-dir fsync after the
+    # rename (PIO502) in durable-prefix code — the rules stack
     synced = _FSYNCLESS.replace(
         "            f.write(data)\n",
         "            f.write(data)\n            os.fsync(f.fileno())\n",
     )
-    assert _codes("predictionio_tpu/data/storage/x.py", synced) == []
+    assert _codes("predictionio_tpu/data/storage/x.py", synced) == ["PIO502"]
+    # the full protocol (file fsync + rename + dir fsync) is clean
+    durable = synced.replace(
+        "        os.replace(path + \".tmp\", path)\n",
+        "        os.replace(path + \".tmp\", path)\n"
+        "        dfd = os.open(os.path.dirname(path), os.O_RDONLY)\n"
+        "        try:\n"
+        "            os.fsync(dfd)\n"
+        "        finally:\n"
+        "            os.close(dfd)\n",
+    )
+    assert _codes("predictionio_tpu/data/storage/x.py", durable) == []
     # a class exposing an fsync toggle is exempt (operator's choice)
     toggled = _FSYNCLESS.replace(
         "class Models:\n",
@@ -1409,8 +1489,10 @@ def test_analysis_package_is_stdlib_only():
             "import predictionio_tpu.analysis.callgraph; "
             "import predictionio_tpu.analysis.rules_program; "
             "import predictionio_tpu.analysis.rules_compile; "
+            "import predictionio_tpu.analysis.rules_durability; "
             "import predictionio_tpu.analysis.witness; "
             "import predictionio_tpu.analysis.jit_witness; "
+            "import predictionio_tpu.analysis.lock_witness; "
             "bad = [m for m in ('jax', 'numpy') if m in sys.modules]; "
             "sys.exit(1 if bad else 0)",
         ],
@@ -1908,3 +1990,464 @@ def test_pio_lint_sarif_cli(tmp_path):
     assert any(
         r["ruleId"] == "PIO101" and r["level"] == "error" for r in results
     )
+
+
+# ---------------------------------------------------------------------------
+# PIO211 + PIO5xx seeded-bug fixtures, waiver pragmas, callgraph edge
+# cases (ISSUE 18)
+# ---------------------------------------------------------------------------
+
+_PIO211_COORD = """\
+import threading
+
+from predictionio_tpu.sink import persist_state
+
+class Coordinator:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def tick(self, path, payload):
+        with self._lock:
+            persist_state(path, payload)
+"""
+
+_PIO211_SINK = """\
+import os
+
+def persist_state(path, payload):
+    with open(path + ".tmp", "w") as f:
+        f.write(payload)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(path + ".tmp", path)
+"""
+
+
+def test_pio211_durable_syscall_under_foreign_lock():
+    """Seeded true positive: a lock owned by one class reaches a
+    durable syscall (os.fsync) performed by a function that does NOT
+    own the lock — every contender convoys on a foreign disk flush."""
+    found = _program_find({
+        "predictionio_tpu/coord.py": _PIO211_COORD,
+        "predictionio_tpu/sink.py": _PIO211_SINK,
+    })
+    assert [f.code for f in found] == ["PIO211"]
+    f = found[0]
+    # anchors at the call site inside the lock region, not at the fsync
+    assert f.path == "predictionio_tpu/coord.py"
+    assert "Coordinator._lock" in f.message
+    assert "os.fsync" in f.message
+    # call-chain provenance rides in the render, never the baseline key
+    assert "via" in f.render() and "via" not in f.message
+    # the lock's own class flushing its own state is the protocol
+    # working as designed, not a foreign-flush convoy
+    own = {
+        "predictionio_tpu/own.py": """\
+        import os
+        import threading
+
+        class Registry:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def publish(self, path, data):
+                with self._lock:
+                    with open(path + ".tmp", "w") as f:
+                        f.write(data)
+                        f.flush()
+                        os.fsync(f.fileno())
+                    os.replace(path + ".tmp", path)
+        """,
+    }
+    assert _program_codes(own) == []
+    # and without the lock held there is nothing to convoy on
+    unlocked = {
+        "predictionio_tpu/coord.py": _PIO211_COORD.replace(
+            "        with self._lock:\n            persist_state",
+            "        persist_state",
+        ),
+        "predictionio_tpu/sink.py": _PIO211_SINK,
+    }
+    assert _program_codes(unlocked) == []
+
+
+def test_waiver_pragma_inline_and_preceding_line():
+    """`# piolint: waive=CODE -- reason` suppresses on the finding's
+    own line AND on a comment-only line directly above (for call sites
+    too long to carry an inline pragma)."""
+    inline = {
+        "predictionio_tpu/coord.py": _PIO211_COORD.replace(
+            "            persist_state(path, payload)",
+            "            persist_state(path, payload)  "
+            "# piolint: waive=PIO211 -- reviewed: cold path",
+        ),
+        "predictionio_tpu/sink.py": _PIO211_SINK,
+    }
+    assert _program_codes(inline) == []
+    above = {
+        "predictionio_tpu/coord.py": _PIO211_COORD.replace(
+            "            persist_state(path, payload)",
+            "            # piolint: waive=PIO211 -- reviewed: cold path\n"
+            "            persist_state(path, payload)",
+        ),
+        "predictionio_tpu/sink.py": _PIO211_SINK,
+    }
+    assert _program_codes(above) == []
+
+
+def test_waiver_without_reason_fires_pio001_and_original():
+    """A reasonless waiver is not a waiver: the engine flags the pragma
+    (PIO001) and the waived code still fires — the ratchet only moves
+    down when someone writes down WHY."""
+    files = {
+        "predictionio_tpu/coord.py": _PIO211_COORD.replace(
+            "            persist_state(path, payload)",
+            "            persist_state(path, payload)  "
+            "# piolint: waive=PIO211",
+        ),
+        "predictionio_tpu/sink.py": _PIO211_SINK,
+    }
+    codes = _program_codes(files)
+    assert "PIO001" in codes and "PIO211" in codes
+
+
+_PIO501_FLEET = """\
+import os
+
+def save(path, data):
+    with open(path + ".tmp", "w") as f:
+        f.write(data)
+    os.replace(path + ".tmp", path)
+"""
+
+
+def test_pio501_pio502_protocol_ladder():
+    """Seeded true positives: each missing protocol step draws exactly
+    the rule that names it, and the full write->flush->fsync->rename->
+    dir-fsync ladder is clean."""
+    # no fsync at all: the rename publishes torn data (PIO501)
+    assert _codes("predictionio_tpu/fleet/x.py", _PIO501_FLEET) == ["PIO501"]
+    # file fsync'd but the directory entry is not (PIO502)
+    synced = _PIO501_FLEET.replace(
+        "        f.write(data)\n",
+        "        f.write(data)\n        os.fsync(f.fileno())\n",
+    )
+    assert _codes("predictionio_tpu/fleet/x.py", synced) == ["PIO502"]
+    # full protocol: clean
+    durable = synced.replace(
+        "    os.replace(path + \".tmp\", path)\n",
+        "    os.replace(path + \".tmp\", path)\n"
+        "    dfd = os.open(os.path.dirname(path), os.O_RDONLY)\n"
+        "    try:\n"
+        "        os.fsync(dfd)\n"
+        "    finally:\n"
+        "        os.close(dfd)\n",
+    )
+    assert _codes("predictionio_tpu/fleet/x.py", durable) == []
+    # PIO502 is durable-roots-only: outside them the dir entry is
+    # best-effort by design
+    assert _codes("predictionio_tpu/api/x.py", synced) == []
+    # rename of a file this function never wrote (claim/mv): not a
+    # publish, no finding
+    mv = """\
+    import os
+
+    def claim(src, dst):
+        os.replace(src, dst)
+    """
+    assert _codes("predictionio_tpu/fleet/x.py", mv) == []
+
+
+_PIO503_MODULE = """\
+import os
+
+def publish(state_path, data):
+    tmp = state_path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, state_path)
+    dfd = os.open(os.path.dirname(state_path), os.O_RDONLY)
+    try:
+        os.fsync(dfd)
+    finally:
+        os.close(dfd)
+
+def note(log_path, line):
+    with open(log_path, "w") as f:
+        f.write(line)
+"""
+
+
+def test_pio503_direct_write_in_protocol_module():
+    """Seeded true positive: a module that publishes via temp+rename
+    elsewhere writes some OTHER final path in place — readers (and
+    crashes) observe the half-written file."""
+    found = [
+        (c, l) for c, l in
+        ((f.code, f.line) for f in lint_sources(
+            {"predictionio_tpu/fleet/x.py": _PIO503_MODULE})[0])
+    ]
+    assert [c for c, _l in found] == ["PIO503"]
+    # append mode never truncates published bytes: exempt
+    appender = _PIO503_MODULE.replace(
+        'open(log_path, "w")', 'open(log_path, "a")'
+    )
+    assert _codes("predictionio_tpu/fleet/x.py", appender) == []
+    # no protocol intent anywhere in the module: no finding
+    no_protocol = """\
+    def note(log_path, line):
+        with open(log_path, "w") as f:
+            f.write(line)
+    """
+    assert _codes("predictionio_tpu/fleet/x.py", no_protocol) == []
+    # outside the durable roots the rule stays silent
+    assert _codes("predictionio_tpu/api/x.py", _PIO503_MODULE) == []
+
+
+def test_pio504_truncate_live_file():
+    """Seeded true positive: open(p, 'w') on a path that is elsewhere
+    the DESTINATION of an atomic rename — the published file is being
+    emptied in place. (PIO503 stacks: a truncate of a live path is also
+    a direct final-path write; both name the same line.)"""
+    src = _PIO503_MODULE.replace(
+        "def note(log_path, line):\n"
+        "    with open(log_path, \"w\") as f:\n",
+        "def reset(state_path, line):\n"
+        "    with open(state_path, \"w\") as f:\n",
+    )
+    found = lint_sources({"predictionio_tpu/fleet/x.py": src})[0]
+    assert sorted({f.code for f in found}) == ["PIO503", "PIO504"]
+    assert len({f.line for f in found}) == 1
+    # writing a tmp-named sibling of the live path is the protocol's
+    # own first half, never a truncate-live finding
+    tmpwrite = _PIO503_MODULE.replace(
+        'open(log_path, "w")', 'open(state_path + ".tmp", "w")'
+    )
+    assert "PIO504" not in _codes("predictionio_tpu/fleet/x.py", tmpwrite)
+
+
+# ---------------------------------------------------------------------------
+# callgraph edge cases: decorators, closures, inheritance, aliases,
+# factory attrs, may-call fan-out (ISSUE 18)
+# ---------------------------------------------------------------------------
+
+
+def _graph(files):
+    from predictionio_tpu.analysis.callgraph import build_callgraph
+    from predictionio_tpu.analysis.engine import FileContext
+    from predictionio_tpu.analysis.manifest import DEFAULT_MANIFEST
+
+    contexts = {
+        p: FileContext(p, textwrap.dedent(s), DEFAULT_MANIFEST)
+        for p, s in files.items()
+    }
+    return build_callgraph(contexts)
+
+
+def _edges(graph):
+    out = set()
+    for qname, fi in graph.functions.items():
+        for cs in fi.calls:
+            for callee in cs.callees:
+                out.add((qname, callee))
+    return out
+
+
+def test_callgraph_decorated_functions():
+    """Decorators (bare, parameterized, staticmethod, property) leave
+    the decorated function resolvable by its plain qname."""
+    g = _graph({"predictionio_tpu/deco.py": """\
+    import functools
+
+    def wrap(fn):
+        return fn
+
+    @wrap
+    def helper():
+        pass
+
+    @functools.lru_cache(maxsize=8)
+    def cached():
+        helper()
+
+    class C:
+        @staticmethod
+        def s():
+            cached()
+
+        @property
+        def p(self):
+            return helper()
+    """})
+    edges = _edges(g)
+    assert ("predictionio_tpu.deco.cached",
+            "predictionio_tpu.deco.helper") in edges
+    assert ("predictionio_tpu.deco.C.s",
+            "predictionio_tpu.deco.cached") in edges
+    assert ("predictionio_tpu.deco.C.p",
+            "predictionio_tpu.deco.helper") in edges
+
+
+def test_callgraph_nested_closures_flatten_into_encloser():
+    """A closure's calls belong to the enclosing function — a lock held
+    by the outer function therefore covers what the inner one calls,
+    which is exactly how the runtime behaves."""
+    g = _graph({"predictionio_tpu/clo.py": """\
+    import threading
+
+    _lock = threading.Lock()
+
+    def leaf():
+        pass
+
+    def outer():
+        def inner():
+            leaf()
+        with _lock:
+            inner()
+    """})
+    edges = _edges(g)
+    assert ("predictionio_tpu.clo.outer",
+            "predictionio_tpu.clo.leaf") in edges
+
+
+def test_callgraph_self_method_through_base_class():
+    """self.helper() on a subclass resolves to the base-class
+    definition, and a lock attribute inherited from the base is still
+    tracked as held on the subclass's call sites."""
+    g = _graph({"predictionio_tpu/basecls.py": """\
+    import threading
+
+    class Base:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def helper(self):
+            pass
+
+    class Derived(Base):
+        def go(self):
+            with self._lock:
+                self.helper()
+    """})
+    fi = g.functions["predictionio_tpu.basecls.Derived.go"]
+    resolved = [cs for cs in fi.calls if cs.callees]
+    assert resolved, "self.helper() through the base went unresolved"
+    assert resolved[0].callees == ("predictionio_tpu.basecls.Base.helper",)
+    assert resolved[0].held == ("predictionio_tpu.basecls.Derived._lock",)
+
+
+def test_callgraph_module_aliases():
+    """`import pkg.mod as u` and `from pkg import mod as u2` both
+    resolve attribute calls through the alias."""
+    g = _graph({
+        "predictionio_tpu/util.py": "def helper():\n    pass\n",
+        "predictionio_tpu/uses.py": """\
+        import predictionio_tpu.util as u
+        from predictionio_tpu import util as u2
+
+        def go():
+            u.helper()
+            u2.helper()
+        """,
+    })
+    edges = [
+        cs.callees
+        for cs in g.functions["predictionio_tpu.uses.go"].calls
+    ]
+    assert edges == [
+        ("predictionio_tpu.util.helper",),
+        ("predictionio_tpu.util.helper",),
+    ]
+
+
+def test_callgraph_factory_attr_alias_and_may_call():
+    """The three resolution powers the runtime witness forced (ISSUE
+    18): (a) an attr assigned from a lowercase factory call is UNKNOWN,
+    not foreign — the duck-typed fallback stays available; (b) a local
+    `svc = self._attr` alias carries the receiver through; (c) the
+    duck-typed fallback returns ALL candidate definitions (may-call)
+    when the method name has a few implementations, not just one."""
+    g = _graph({
+        "predictionio_tpu/impls.py": """\
+        class DriverA:
+            def tail_follow(self):
+                pass
+
+        class DriverB:
+            def tail_follow(self):
+                pass
+        """,
+        "predictionio_tpu/userm.py": """\
+        from predictionio_tpu.storage import Storage
+        from predictionio_tpu.vendor import OpaqueClient
+
+        class Follower:
+            def __init__(self):
+                self._pe = Storage.get_p_events()
+                self._cli = OpaqueClient()
+
+            def poll(self):
+                self._pe.tail_follow()
+
+            def route(self):
+                svc = self._pe
+                svc.tail_follow()
+
+            def push(self):
+                self._cli.tail_follow()
+        """,
+        "predictionio_tpu/storage.py": """\
+        class Storage:
+            @staticmethod
+            def get_p_events():
+                pass
+        """,
+    })
+    may_call = (
+        "predictionio_tpu.impls.DriverA.tail_follow",
+        "predictionio_tpu.impls.DriverB.tail_follow",
+    )
+    ci = g.classes["predictionio_tpu.userm.Follower"]
+    assert "_pe" not in ci.attr_foreign  # (a) factory attr is unknown
+    assert "_cli" in ci.attr_foreign  # unresolvable CLASS ctor is foreign
+    poll = g.functions["predictionio_tpu.userm.Follower.poll"].calls
+    assert poll[0].callees == may_call  # (c) may-call fan-out
+    route = g.functions["predictionio_tpu.userm.Follower.route"].calls
+    assert route[0].callees == may_call  # (b) alias carries the receiver
+    # a FOREIGN receiver never duck-types: no in-tree edge is recorded
+    push = g.functions["predictionio_tpu.userm.Follower.push"].calls
+    assert all(not cs.callees for cs in push)
+
+
+def test_cli_exit_code_contract(tmp_path):
+    """docs/development.md exit codes: 0 clean, 1 findings, 2 internal
+    error — a CI job can tell a dirty tree from a broken linter. (The
+    rc=1 leg lives in test_pio_lint_sarif_cli.)"""
+    pkg = tmp_path / "predictionio_tpu"
+    pkg.mkdir()
+    (pkg / "ok.py").write_text("X = 1\n")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    base = [
+        sys.executable, "-m", "predictionio_tpu.tools.console",
+        "lint", "--root", str(tmp_path),
+    ]
+    proc = subprocess.run(
+        base, capture_output=True, text=True, timeout=120, env=env, cwd=REPO
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    # a malformed baseline is the LINTER failing, not the tree: rc 2,
+    # diagnostic on stderr, and stdout stays parseable (empty)
+    broken = tmp_path / "baseline.json"
+    broken.write_text("{not json")
+    proc = subprocess.run(
+        base + ["--baseline", str(broken), "--format", "json"],
+        capture_output=True, text=True, timeout=120, env=env, cwd=REPO,
+    )
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+    assert "piolint: internal error" in proc.stderr
+    assert proc.stdout.strip() == ""
