@@ -94,6 +94,25 @@ DEFAULT_MANIFEST: Manifest = (
         "it)",
     ),
     PackageRule(
+        package="predictionio_tpu/workflow/aot.py",
+        stdlib_only=True,
+        allow=(
+            "jax",
+            "jaxlib",
+            "numpy",
+            "predictionio_tpu.workflow",
+            "predictionio_tpu.analysis",
+            "predictionio_tpu.fleet",
+        ),
+        reason="the AOT artifact schema (manifest.json, sha256 + shape "
+        "fingerprints) is owned by the stdlib-only fleet registry so the "
+        "router and `pio status` can verify readiness with nothing "
+        "installed; this module adds only the jax halves (export + "
+        "deserialize), importing jax/jaxlib/numpy lazily inside those "
+        "functions — importing the module (or running the default, "
+        "AOT-off deploy) never touches them",
+    ),
+    PackageRule(
         package="predictionio_tpu/fleet",
         stdlib_only=True,
         allow=(

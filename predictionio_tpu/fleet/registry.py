@@ -44,17 +44,23 @@ from __future__ import annotations
 
 import dataclasses
 import datetime as _dt
+import hashlib
 import json
 import os
+import shutil
 import tempfile
 import time
 from typing import Any
 
 __all__ = [
+    "AOT_MANIFEST_NAME",
     "EndpointRecord",
     "EndpointRegistry",
     "ModelRegistry",
     "RegistryRecord",
+    "aot_artifact_dir",
+    "read_aot_manifest",
+    "verify_aot_artifacts",
 ]
 
 _HISTORY_LIMIT = 50
@@ -71,6 +77,100 @@ def _fsync_dir(directory: str) -> None:
         os.close(fd)
 
 
+# ---------------------------------------------------------------------------
+# AOT artifact schema (read side — stdlib by contract)
+# ---------------------------------------------------------------------------
+#
+# ``pio train --aot`` (workflow/aot.py, the jax write side) serializes
+# each generation's serving programs into ``<root>/<instance>/`` beside a
+# ``manifest.json`` carrying the environment fingerprint and per-blob
+# SHA-256 + byte-size records. The READ side lives here because the
+# consumers that gate on artifact readiness — the router's rolling-reload
+# gate, ``pio status`` — are stdlib-only by manifest: presence, parse,
+# size, and digest checks need hashlib+json, nothing more. Fingerprint
+# MATCHING against the live jax environment is the replica's job at
+# deserialize time (it has jax by definition); a reader here only
+# reports the manifest's fingerprint for display/compare.
+
+AOT_MANIFEST_NAME = "manifest.json"
+
+
+def aot_artifact_dir(root: str, engine_instance_id: str) -> str:
+    """``<root>/<instance>`` through a character allow-list, so an
+    adversarial instance id cannot escape the artifact root (same
+    contract as endpoint entry filenames)."""
+    safe = "".join(
+        c if c.isalnum() or c in "._-" else "_" for c in engine_instance_id
+    )[:128]
+    if not safe:
+        raise ValueError(f"unusable engine instance id {engine_instance_id!r}")
+    return os.path.join(root, safe)
+
+
+def read_aot_manifest(instance_dir: str) -> dict | None:
+    """The artifact manifest, or None when absent/torn."""
+    try:
+        with open(os.path.join(instance_dir, AOT_MANIFEST_NAME)) as f:
+            doc = json.load(f)
+    except (FileNotFoundError, json.JSONDecodeError, OSError):
+        return None
+    return doc if isinstance(doc, dict) else None
+
+
+def verify_aot_artifacts(instance_dir: str, deep: bool = True) -> dict:
+    """Pure-stdlib readiness check of one artifact directory: manifest
+    present + parseable, every blob present with the manifested size,
+    and (``deep``) a matching SHA-256. Returns ``{"ok": bool,
+    "problems": [...], "programs": N, "bytes": N, "fingerprint": {...}}``."""
+    problems: list[str] = []
+    manifest = read_aot_manifest(instance_dir)
+    if manifest is None:
+        return {
+            "ok": False,
+            "problems": [f"missing or torn {AOT_MANIFEST_NAME}"],
+            "programs": 0,
+            "bytes": 0,
+            "fingerprint": None,
+        }
+    total = 0
+    entries = manifest.get("entries", [])
+    for entry in entries:
+        path = os.path.join(instance_dir, entry.get("file", ""))
+        try:
+            size = os.path.getsize(path)
+        except OSError:
+            problems.append(f"missing blob {entry.get('file')}")
+            continue
+        if size != entry.get("bytes"):
+            problems.append(
+                f"size mismatch {entry.get('file')}: "
+                f"{size} != {entry.get('bytes')}"
+            )
+            continue
+        if deep:
+            h = hashlib.sha256()
+            try:
+                with open(path, "rb") as f:
+                    for chunk in iter(lambda: f.read(1 << 20), b""):
+                        h.update(chunk)
+            except OSError as e:
+                problems.append(f"unreadable blob {entry.get('file')}: {e}")
+                continue
+            if h.hexdigest() != entry.get("sha256"):
+                problems.append(f"digest mismatch {entry.get('file')}")
+                continue
+        total += size
+    if not entries:
+        problems.append("manifest lists no programs")
+    return {
+        "ok": not problems,
+        "problems": problems,
+        "programs": len(entries),
+        "bytes": total,
+        "fingerprint": manifest.get("fingerprint"),
+    }
+
+
 @dataclasses.dataclass(frozen=True)
 class RegistryRecord:
     """One published fleet generation."""
@@ -79,6 +179,11 @@ class RegistryRecord:
     engine_instance_id: str
     published_at: str  # ISO-8601 UTC
     meta: dict | None = None
+    #: AOT artifact stamp (``pio train --aot``): ``{"dir", "programs",
+    #: "bytes", "fingerprint"}`` — the router's rolling gate and `pio
+    #: status` verify readiness against this; None = generation published
+    #: without AOT (replicas serve through the JIT path)
+    artifacts: dict | None = None
 
     def to_json(self) -> dict:
         out: dict[str, Any] = {
@@ -88,6 +193,8 @@ class RegistryRecord:
         }
         if self.meta:
             out["meta"] = dict(self.meta)
+        if self.artifacts:
+            out["artifacts"] = dict(self.artifacts)
         return out
 
     @staticmethod
@@ -97,6 +204,7 @@ class RegistryRecord:
             engine_instance_id=str(d["engineInstanceId"]),
             published_at=str(d.get("publishedAt", "")),
             meta=d.get("meta"),
+            artifacts=d.get("artifacts"),
         )
 
 
@@ -143,24 +251,52 @@ class ModelRegistry:
 
     # -------------------------------------------------------------- write
     def publish(
-        self, engine_instance_id: str, meta: dict | None = None
+        self,
+        engine_instance_id: str,
+        meta: dict | None = None,
+        artifacts: dict | None = None,
     ) -> RegistryRecord:
         """Stamp the next fleet generation. Atomic rename; fsync'd so an
         acked publish survives a host crash (same durability contract as
-        the model blobs it points at)."""
+        the model blobs it points at).
+
+        ``artifacts`` (``pio train --aot``) stamps the generation's AOT
+        artifact set — ``{"dir", "programs", "bytes", "fingerprint"}`` —
+        beside the instance pointer. A re-publish of an instance whose
+        artifacts are already on file (e.g. the router's post-rotation
+        publish) inherits the newest prior stamp automatically, so
+        rolling swaps never orphan a live artifact set.
+
+        Artifact GC rides every publish: the bounded history is the ONLY
+        thing keeping artifact blobs alive, so generations evicted off
+        its tail take their artifact directories with them (unless a
+        surviving generation still references the same dir) — repeated
+        rolling swaps cannot grow the artifact root without bound."""
         doc = self._load()
         prev = doc.get("current") or {}
         generation = int(prev.get("generation", 0)) + 1
+        if artifacts is None:
+            # inherit the newest prior stamp for this instance
+            for d in [prev] + list(doc.get("history", [])):
+                if (
+                    isinstance(d, dict)
+                    and d.get("engineInstanceId") == engine_instance_id
+                    and d.get("artifacts")
+                ):
+                    artifacts = dict(d["artifacts"])
+                    break
         record = RegistryRecord(
             generation=generation,
             engine_instance_id=engine_instance_id,
             published_at=_dt.datetime.now(_dt.timezone.utc).isoformat(),
             meta=meta,
+            artifacts=artifacts,
         )
         history = [record.to_json()] + list(doc.get("history", []))
+        kept, evicted = history[:_HISTORY_LIMIT], history[_HISTORY_LIMIT:]
         new_doc = {
             "current": record.to_json(),
-            "history": history[:_HISTORY_LIMIT],
+            "history": kept,
         }
         os.makedirs(self.directory, exist_ok=True)
         fd, tmp = tempfile.mkstemp(
@@ -178,7 +314,32 @@ class ModelRegistry:
                 os.unlink(tmp)
             except FileNotFoundError:
                 pass
+        if evicted:
+            self._gc_artifacts(kept, evicted)
         return record
+
+    @staticmethod
+    def _gc_artifacts(kept: list, evicted: list) -> None:
+        """Delete artifact directories that left the bounded history with
+        their generations. Deletion is gated twice: the dir must not be
+        referenced by ANY surviving record (current is kept[0]), and it
+        must actually look like an artifact set (its manifest file
+        exists) — a corrupted record can never aim the rmtree at an
+        arbitrary path."""
+        live_dirs = {
+            (d.get("artifacts") or {}).get("dir")
+            for d in kept
+            if isinstance(d, dict)
+        }
+        for d in evicted:
+            if not isinstance(d, dict):
+                continue
+            adir = (d.get("artifacts") or {}).get("dir")
+            if not adir or adir in live_dirs:
+                continue
+            if not os.path.isfile(os.path.join(adir, AOT_MANIFEST_NAME)):
+                continue
+            shutil.rmtree(adir, ignore_errors=True)
 
 
 # ---------------------------------------------------------------------------

@@ -1065,6 +1065,39 @@ class RouterService:
                     target.engine_instance_id if target else None
                 ),
             }
+            if target is not None and target.artifacts:
+                # artifact-readiness gate (pio train --aot): the target
+                # generation declares an AOT artifact set, so verify it —
+                # stdlib manifest parse + blob size/sha256 (registry.py)
+                # — BEFORE rotating a single replica. Rotating onto a
+                # missing/torn artifact dir would demote the whole fleet
+                # to JIT fallback at once, the exact cold-start spike AOT
+                # exists to remove; failing the rotation here keeps every
+                # replica serving warm while the operator re-exports.
+                # Fingerprint matching stays the replicas' job — they
+                # have jax, this router does not.
+                from predictionio_tpu.fleet.registry import (
+                    verify_aot_artifacts,
+                )
+
+                adir = target.artifacts.get("dir", "")
+                check = verify_aot_artifacts(adir) if adir else {
+                    "ok": False,
+                    "problems": ["artifact stamp carries no dir"],
+                }
+                report["artifactCheck"] = {
+                    "dir": adir,
+                    "ok": check["ok"],
+                    "problems": check.get("problems", []),
+                }
+                if not check["ok"]:
+                    report["ok"] = False
+                    report["error"] = (
+                        "registry generation declares AOT artifacts but "
+                        "the artifact set failed verification; rotation "
+                        "aborted before touching any replica"
+                    )
+                    return 500, report
             ok = True
             for rep in self.replicas:
                 entry: dict[str, Any] = {"generationBefore": rep.generation}

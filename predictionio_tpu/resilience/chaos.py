@@ -899,6 +899,11 @@ class ServeChaosConfig:
     throughput_seconds: float = 3.0
     #: also measure one fleet whose replicas serve ``--shard-factors``
     sharded_point: bool = False
+    #: run the drill AOT-on: ``pio train --aot`` exports the serving
+    #: programs, replicas deploy ``--aot``, and the rolling phase
+    #: additionally asserts ZERO serve-time compiles across the full
+    #: rotation (every replica tier 1; docs/operations.md AOT runbook)
+    aot: bool = False
     probe_interval_s: float = 0.25
     breaker_reset_s: float = 1.0
     query_timeout_s: float = 20.0
@@ -1070,11 +1075,12 @@ class _FleetProc:
             except Exception:
                 return {"ok": False, "error": f"HTTP {e.code}"}
 
-    def router_stats(self) -> dict | None:
+    def router_stats(self, fanout: bool = False) -> dict | None:
+        url = f"http://127.0.0.1:{self.port}/stats.json"
+        if fanout:
+            url += "?fanout=1"
         try:
-            with urllib.request.urlopen(
-                f"http://127.0.0.1:{self.port}/stats.json", timeout=5
-            ) as resp:
+            with urllib.request.urlopen(url, timeout=5) as resp:
                 return json.loads(resp.read())
         except Exception:
             return None
@@ -1278,9 +1284,12 @@ def _serve_setup(env: dict, base: str, cfg: ServeChaosConfig) -> str:
             },
             f,
         )
+    train_args = ["train", "--engine-json", engine_json, "--mesh", "none"]
+    if getattr(cfg, "aot", False):
+        train_args.append("--aot")
     _run_pio(
         env,
-        ["train", "--engine-json", engine_json, "--mesh", "none"],
+        train_args,
         cfg.startup_timeout_s * 2,  # first train pays the XLA compile
         "train",
     )
@@ -1439,7 +1448,7 @@ def _rolling_phase(fleet: "_FleetProc", cfg: ServeChaosConfig) -> dict:
     clients.join()
     overall = clients.summarize(t0, t_end)
     stats = fleet.router_stats() or {}
-    return {
+    out = {
         "overall": overall,
         "reloads": reload_reports,
         "reloadsOk": all(r.get("ok") for r in reload_reports),
@@ -1450,6 +1459,35 @@ def _rolling_phase(fleet: "_FleetProc", cfg: ServeChaosConfig) -> dict:
         ),
         "failedQueries": overall["failed"] + overall["transportErrors"],
     }
+    if cfg.aot:
+        # AOT rolling contract (docs/operations.md AOT runbook): after a
+        # full rotation every replica must serve deserialized programs
+        # (tier 1) and have witnessed ZERO compiles since its boot
+        # finished — a rotation that recompiles is the regression this
+        # drill exists to catch. Read through the router's stats fanout
+        # so the drill stays wire-only.
+        fan = fleet.router_stats(fanout=True) or {}
+        per_replica: dict[str, Any] = {}
+        total = 0
+        tiers_ok = True
+        for rid, rstats in (fan.get("replicaStats") or {}).items():
+            aot_block = (
+                rstats.get("aot") if isinstance(rstats, dict) else None
+            ) or {}
+            compiles = aot_block.get("serveTimeCompiles")
+            per_replica[rid] = {
+                "tier": aot_block.get("tier"),
+                "serveTimeCompiles": compiles,
+            }
+            total += int(compiles or 0)
+            if aot_block.get("tier") != 1:
+                tiers_ok = False
+        out["aot"] = {
+            "perReplica": per_replica,
+            "serveTimeCompiles": total,
+            "allTier1": bool(per_replica) and tiers_ok,
+        }
+    return out
 
 
 def run_chaos_serve(cfg: ServeChaosConfig) -> dict:
@@ -1463,8 +1501,10 @@ def run_chaos_serve(cfg: ServeChaosConfig) -> dict:
         "replicas": cfg.replicas,
         "clients": cfg.clients,
         "seed": cfg.seed,
+        "aot": cfg.aot,
         "cpuCount": os.cpu_count(),
     }
+    aot_args = ("--aot",) if cfg.aot else ()
     fleet: _FleetProc | None = None
     t_start = time.monotonic()
     try:
@@ -1477,7 +1517,8 @@ def run_chaos_serve(cfg: ServeChaosConfig) -> dict:
         for r in cfg.throughput_replicas:
             keep = r == cfg.replicas and r == cfg.throughput_replicas[-1]
             point, kept = _throughput_point(
-                env, base, engine_json, cfg, r, keep_fleet=keep
+                env, base, engine_json, cfg, r,
+                extra_args=aot_args, keep_fleet=keep,
             )
             points.append(point)
             if kept is not None:
@@ -1502,7 +1543,10 @@ def run_chaos_serve(cfg: ServeChaosConfig) -> dict:
 
         # ---- phase 2: replica SIGKILL under load
         if fleet is None:
-            fleet = _FleetProc(env, base, engine_json, cfg.replicas, cfg)
+            fleet = _FleetProc(
+                env, base, engine_json, cfg.replicas, cfg,
+                extra_args=aot_args,
+            )
             fleet.wait_all_ready(cfg.startup_timeout_s)
         report["kill"] = _kill_phase(fleet, cfg)
 
@@ -1559,6 +1603,17 @@ def run_chaos_serve(cfg: ServeChaosConfig) -> dict:
         and rolling.get("reloadsOk")
         and rolling.get("converged")
         and rolling.get("crossGenerationViolations") == 0
+        # AOT rolling contract: a full rotation must land every replica
+        # on tier 1 with zero serve-time compiles (the jit-witness gate,
+        # asserted over the wire instead of in-process)
+        and (
+            not cfg.aot
+            or cfg.reloads == 0
+            or (
+                rolling.get("aot", {}).get("serveTimeCompiles") == 0
+                and rolling.get("aot", {}).get("allTier1")
+            )
+        )
         # q/s must scale on a multi-core host; a one-core host documents
         # the ceiling instead of faking the claim (memory: one-core boxes
         # wall every throughput-ratio assertion)

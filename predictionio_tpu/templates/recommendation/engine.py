@@ -864,6 +864,11 @@ class ALSAlgorithm(JaxAlgorithm):
         codes), and the QuantRuntime goes with them."""
         shards = getattr(model, "_pio_shards", None)
         quantized = getattr(model, "_pio_quant", None) is not None
+        # the AOT runtime is per-generation (its programs are lowered
+        # against this generation's table shapes) — it retires with the
+        # pinned buffers
+        if getattr(model, "_pio_aot", None) is not None:
+            model._pio_aot = None
         if shards is not None:
             model.user_factors = np.asarray(model.user_factors)[
                 : shards.rows["user"]
@@ -923,7 +928,60 @@ class ALSAlgorithm(JaxAlgorithm):
         if getattr(model, "_pio_ann", None) is not None:
             model._pio_ann = None
 
-    # --------------------------------------------------- online fold-in
+    # --------------------------------------------------- AOT serving export
+    def aot_export_for_serving(self, model: ALSModel, buckets: list) -> dict:
+        """``--aot`` tier (workflow/aot.py): lower + serialize the pinned
+        exact serving programs per pow2 k-bucket, so replicas boot by
+        DESERIALIZING instead of tracing — zero serve-time compiles.
+
+        The export mirrors the JIT path's deliberate program split —
+        k-independent ``predict_scores`` plus per-bucket ``top_k_scores``
+        (and the batch GEMM+top-k per chunk/bucket) — rather than fusing
+        score+select into one program, so bit-identity with the jitted
+        path holds by construction: same jaxprs, same rounding, same tie
+        order. Sharded/quantized/ANN generations export nothing — their
+        kernels close over live runtime objects (mesh, codes, index) and
+        serve through their own budgeted paths."""
+        if getattr(model, "_pio_shards", None) is not None:
+            return {}
+        if getattr(model, "_pio_quant", None) is not None:
+            return {}
+        import jax
+        from jax import export as jax_export
+
+        from predictionio_tpu.ops.als import predict_scores, top_k_items_batch
+        from predictionio_tpu.ops.topk import top_k_scores
+
+        n_users, rank = (int(d) for d in model.user_factors.shape)
+        n_items = int(model.item_factors.shape[0])
+        f32 = np.dtype(np.float32)
+        vec = jax.ShapeDtypeStruct((rank,), f32)
+        users = jax.ShapeDtypeStruct((n_users, rank), f32)
+        items = jax.ShapeDtypeStruct((n_items, rank), f32)
+        chunk = self.BATCH_PREDICT_CHUNK
+        idx_chunk = jax.ShapeDtypeStruct((chunk,), np.dtype(np.int32))
+        out = {"predict_scores": jax_export.export(predict_scores)(vec, items)}
+        for kb in buckets:
+            # bind the static k through a jitted closure — jax.export
+            # lowers concrete avals, static_argnames stay host-side
+            out[f"top_k_scores_b{kb}"] = jax_export.export(
+                jax.jit(lambda s, _k=kb: top_k_scores(s, _k))
+            )(jax.ShapeDtypeStruct((n_items,), f32))
+            out[f"top_k_items_batch_c{chunk}_b{kb}"] = jax_export.export(
+                jax.jit(
+                    lambda u, um, im, _k=kb: top_k_items_batch(u, um, im, _k)
+                )
+            )(idx_chunk, users, items)
+        return out
+
+    def aot_warm_serving(self, model: ALSModel) -> None:
+        """Warm the pinned predict path's eager GLUE at boot: the
+        ``user_factors[uidx]`` row gather (dynamic_slice + squeeze) is
+        index-operand cached by jax, so one call here compiles the
+        executables every user's query will reuse — without it the
+        first query after an AOT boot still witnesses two compiles."""
+        if getattr(model, "_pio_pinned", False):
+            _ = model.user_factors[0]
     @staticmethod
     def _online_state(model: ALSModel, max_entities: int) -> dict:
         """Per-model online rating accumulator (LRU-bounded per side):
@@ -1189,10 +1247,30 @@ class ALSAlgorithm(JaxAlgorithm):
             from predictionio_tpu.ops.topk import bucket_k, top_k_scores
 
             kb = bucket_k(k, int(model.item_factors.shape[0]))
-            dev_scores = predict_scores(
-                model.user_factors[uidx], model.item_factors
-            )
-            idx, scores = top_k_scores(dev_scores, kb)
+            idx = scores = None
+            aot = getattr(model, "_pio_aot", None)
+            if aot is not None:
+                # --aot tier 1: the SAME two programs, deserialized at
+                # boot instead of traced here; any call-time failure
+                # (e.g. shape drift after an online catalog grow)
+                # disables the key and the jitted path takes over
+                score_fn = aot.get("predict_scores")
+                topk_fn = aot.get(f"top_k_scores_b{kb}")
+                if score_fn is not None and topk_fn is not None:
+                    try:
+                        dev_scores = score_fn(
+                            model.user_factors[uidx], model.item_factors
+                        )
+                        idx, scores = topk_fn(dev_scores)
+                    except Exception as e:  # noqa: BLE001 - degrade, don't 500
+                        aot.disable("predict_scores", str(e))
+                        aot.disable(f"top_k_scores_b{kb}", str(e))
+                        idx = scores = None
+            if idx is None:
+                dev_scores = predict_scores(
+                    model.user_factors[uidx], model.item_factors
+                )
+                idx, scores = top_k_scores(dev_scores, kb)
             pairs = [
                 (int(i), float(s))
                 for i, s in zip(np.asarray(idx)[:k], np.asarray(scores)[:k])
@@ -1254,6 +1332,7 @@ class ALSAlgorithm(JaxAlgorithm):
             ann=getattr(model, "_pio_ann", None),
             shards=getattr(model, "_pio_shards", None),
             quant=getattr(model, "_pio_quant", None),
+            aot=getattr(model, "_pio_aot", None),
         )
 
     def batch_predict_json(

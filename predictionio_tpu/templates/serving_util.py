@@ -50,7 +50,7 @@ def _drain_staged(
 
 def chunked_topk(
     user_mat, item_mat, valid: Sequence[tuple], chunk: int = TOPK_CHUNK,
-    ann=None, shards=None, quant=None,
+    ann=None, shards=None, quant=None, aot=None,
 ) -> Iterator[tuple[list, list, list]]:
     """Chunked batch top-k over ``valid = [(slot, uidx, k), ...]``;
     yields ``(part, ids, scores)`` with ids/scores as Python lists — the
@@ -81,6 +81,13 @@ def chunked_topk(
     scores only its ``[B,K]@[K,I/S]`` slice; tie-stable-identical
     results), and the ANN path resolves query rows through the sharded
     gather before the cluster-sharded probe kernel.
+
+    ``aot`` (a :class:`predictionio_tpu.workflow.aot.AotRuntime`, the
+    ``--aot`` tier) serves the exact on-device branch through the
+    generation's DESERIALIZED batch program (same jaxpr as
+    ``top_k_items_batch``, so results are bit-identical) instead of the
+    jitted one — zero serve-time compiles; a call-time failure disables
+    the program key and the very next chunk takes the jitted path.
 
     ``quant`` (a :class:`predictionio_tpu.ops.quant.QuantRuntime`, the
     ``--quantize int8`` tier) means both tables are int8 codes + per-row
@@ -194,9 +201,18 @@ def chunked_topk(
 
             padded = np.zeros(chunk, np.int32)
             padded[: len(part)] = uidx_arr
-            idx_b, score_b = top_k_items_batch(
-                padded, user_mat, item_mat, k_max
-            )
+            aot_key = f"top_k_items_batch_c{chunk}_b{k_max}"
+            fn = aot.get(aot_key) if aot is not None else None
+            if fn is not None:
+                try:
+                    idx_b, score_b = fn(padded, user_mat, item_mat)
+                except Exception as e:  # noqa: BLE001 - degrade, don't 500
+                    aot.disable(aot_key, str(e))
+                    fn = None
+            if fn is None:
+                idx_b, score_b = top_k_items_batch(
+                    padded, user_mat, item_mat, k_max
+                )
         else:
             from predictionio_tpu.ops.topk import top_k_host
 

@@ -487,6 +487,10 @@ class TwoTowerAlgorithm(JaxAlgorithm):
     def release_pinned_model(self, model: TwoTowerServingModel) -> None:
         shards = getattr(model, "_pio_shards", None)
         quantized = getattr(model, "_pio_quant", None) is not None
+        # the AOT runtime is lowered against this generation's tower
+        # shapes — it retires with the pinned buffers
+        if getattr(model, "_pio_aot", None) is not None:
+            model._pio_aot = None
         if shards is not None:
             # every device's shard handles die here, and the host copy
             # strips the even-shard padding rows (np.asarray dequantizes
@@ -507,7 +511,56 @@ class TwoTowerAlgorithm(JaxAlgorithm):
             model._pio_pinned = False
             model._pio_quant = None
 
-    # --------------------------------------------------- ANN retrieval
+    # --------------------------------------------------- AOT serving export
+    def aot_export_for_serving(
+        self, model: TwoTowerServingModel, buckets: list
+    ) -> dict:
+        """``--aot`` tier (workflow/aot.py): same contract as the
+        recommendation template — serialize the pinned exact serving
+        programs (k-independent ``predict_scores`` + per-bucket top-k,
+        plus the chunked batch GEMM) so replicas deserialize at boot
+        instead of tracing; the two-program split keeps results
+        bit-identical to the jitted path by construction. Sharded and
+        quantized generations export nothing (their kernels close over
+        live runtime objects)."""
+        if getattr(model, "_pio_shards", None) is not None:
+            return {}
+        if getattr(model, "_pio_quant", None) is not None:
+            return {}
+        import jax
+        from jax import export as jax_export
+
+        from predictionio_tpu.ops.als import predict_scores, top_k_items_batch
+        from predictionio_tpu.ops.topk import top_k_scores
+        from predictionio_tpu.templates.serving_util import TOPK_CHUNK
+
+        n_users, rank = (int(d) for d in model.user_vecs.shape)
+        n_items = int(model.item_vecs.shape[0])
+        f32 = np.dtype(np.float32)
+        vec = jax.ShapeDtypeStruct((rank,), f32)
+        users = jax.ShapeDtypeStruct((n_users, rank), f32)
+        items = jax.ShapeDtypeStruct((n_items, rank), f32)
+        idx_chunk = jax.ShapeDtypeStruct((TOPK_CHUNK,), np.dtype(np.int32))
+        out = {"predict_scores": jax_export.export(predict_scores)(vec, items)}
+        for kb in buckets:
+            out[f"top_k_scores_b{kb}"] = jax_export.export(
+                jax.jit(lambda s, _k=kb: top_k_scores(s, _k))
+            )(jax.ShapeDtypeStruct((n_items,), f32))
+            out[f"top_k_items_batch_c{TOPK_CHUNK}_b{kb}"] = jax_export.export(
+                jax.jit(
+                    lambda u, um, im, _k=kb: top_k_items_batch(u, um, im, _k)
+                )
+            )(idx_chunk, users, items)
+        return out
+
+    def aot_warm_serving(self, model: TwoTowerServingModel) -> None:
+        """Warm the pinned predict path's eager GLUE at boot: the
+        ``user_vecs[uidx]`` row gather (dynamic_slice + squeeze) is
+        index-operand cached by jax, so one call here compiles the
+        executables every user's query will reuse (see the
+        recommendation template's twin)."""
+        if getattr(model, "_pio_pinned", False):
+            _ = model.user_vecs[0]
     def build_ann_for_serving(
         self, model: TwoTowerServingModel, ann
     ) -> tuple[TwoTowerServingModel, dict]:
@@ -622,6 +675,7 @@ class TwoTowerAlgorithm(JaxAlgorithm):
             ann=getattr(model, "_pio_ann", None),
             shards=getattr(model, "_pio_shards", None),
             quant=getattr(model, "_pio_quant", None),
+            aot=getattr(model, "_pio_aot", None),
         ):
             for (oi, _, k), ids, scs in zip(part, idx_l, score_l):
                 seen = seen_by_slot[oi]
@@ -705,8 +759,29 @@ class TwoTowerAlgorithm(JaxAlgorithm):
             from predictionio_tpu.ops.topk import bucket_k, top_k_scores
 
             kb = bucket_k(k, int(model.item_vecs.shape[0]))
-            dev_scores = predict_scores(model.user_vecs[uidx], model.item_vecs)
-            idx, sc = top_k_scores(dev_scores, kb)
+            idx = sc = None
+            aot = getattr(model, "_pio_aot", None)
+            if aot is not None:
+                # --aot tier 1: same two programs, deserialized at boot;
+                # call-time failure disables the key and the jitted path
+                # takes over on the next dispatch
+                score_fn = aot.get("predict_scores")
+                topk_fn = aot.get(f"top_k_scores_b{kb}")
+                if score_fn is not None and topk_fn is not None:
+                    try:
+                        dev_scores = score_fn(
+                            model.user_vecs[uidx], model.item_vecs
+                        )
+                        idx, sc = topk_fn(dev_scores)
+                    except Exception as e:  # noqa: BLE001 - degrade, don't 500
+                        aot.disable("predict_scores", str(e))
+                        aot.disable(f"top_k_scores_b{kb}", str(e))
+                        idx = sc = None
+            if idx is None:
+                dev_scores = predict_scores(
+                    model.user_vecs[uidx], model.item_vecs
+                )
+                idx, sc = top_k_scores(dev_scores, kb)
             pairs = [
                 (int(i), float(s))
                 for i, s in zip(np.asarray(idx)[:k], np.asarray(sc)[:k])

@@ -438,10 +438,17 @@ def status_check(out: Out = _print) -> dict:
     for role, status in results.items():
         out(f"  {role:<10} {status}")
     fleets = fleet_status(out)
+    try:
+        aot_rows = aot_artifact_status(out)
+    except Exception as e:  # a torn registry must not fail the storage check
+        aot_rows = None
+        results["aotArtifacts"] = f"FAILED: {e}"
     out("(sanity check) All systems go!" if ok else "Storage check FAILED")
     results["ok"] = ok
     if fleets:
         results["fleets"] = fleets
+    if aot_rows is not None:
+        results["aotArtifacts"] = aot_rows
     return results
 
 
@@ -552,6 +559,81 @@ def fleet_status(out: Out = _print) -> list[dict]:
                 )
             )
     return fleets
+
+
+def aot_artifact_status(out: Out = _print) -> list[dict] | None:
+    """Per-generation AOT artifact readiness for ``pio status`` — the
+    operator's answer to "will ``pio deploy --aot`` boot tier 1 on THIS
+    host?" (ISSUE 19; docs/operations.md AOT runbook). Read-only over
+    the fleet model registry and the artifact dirs it stamps:
+
+    * ``present`` — manifest + blobs verify (sha256) and the recorded
+      fingerprint matches this host's jax/jaxlib/backend;
+    * ``fingerprint-stale`` — blobs verify but were exported under a
+      different environment (boot would fall back loudly to tier 2/3);
+    * ``missing`` — stamped but the dir is gone, torn, or corrupt.
+
+    Generations published without ``pio train --aot`` show ``None``
+    (the JIT path). Returns ``None`` — and prints nothing — when no
+    generation carries an artifact stamp, so a fleet that never opted
+    in sees zero new output (CI-guarded)."""
+    from predictionio_tpu.fleet.registry import (
+        ModelRegistry,
+        verify_aot_artifacts,
+    )
+
+    registry = ModelRegistry(os.path.join(Storage.base_dir(), "fleet"))
+    records = []
+    cur = registry.current()
+    if cur is not None:
+        records.append(cur)
+    records.extend(registry.history())  # history[0] repeats current
+    if not any(r.artifacts for r in records):
+        return None
+    # lazy: only a stamped registry pays the jax-side fingerprint read
+    from predictionio_tpu.workflow.aot import (
+        current_fingerprint,
+        fingerprint_mismatches,
+    )
+
+    live = current_fingerprint()
+    rows: list[dict] = []
+    seen: set[int] = set()
+    for rec in records:
+        if rec.generation in seen:
+            continue
+        seen.add(rec.generation)
+        row: dict = {
+            "generation": rec.generation,
+            "engineInstanceId": rec.engine_instance_id,
+            "artifacts": None,
+        }
+        if rec.artifacts:
+            adir = rec.artifacts.get("dir", "")
+            verdict = (
+                verify_aot_artifacts(adir)
+                if adir
+                else {"ok": False, "fingerprint": None}
+            )
+            if not verdict["ok"]:
+                row["artifacts"] = "missing"
+            else:
+                mismatches = fingerprint_mismatches(
+                    verdict.get("fingerprint") or {}, live
+                )
+                if mismatches:
+                    row["artifacts"] = "fingerprint-stale"
+                    row["mismatches"] = mismatches
+                else:
+                    row["artifacts"] = "present"
+            row["dir"] = adir
+        rows.append(row)
+    for row in rows:
+        out(
+            f"  aot        gen {row['generation']} "
+            f"{row['engineInstanceId']}: {row['artifacts'] or '(jit)'}"
+        )
+    return rows
 
 
 def _router_registry_dir(router_port: int | None) -> str | None:

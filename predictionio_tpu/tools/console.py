@@ -126,6 +126,27 @@ def build_parser() -> argparse.ArgumentParser:
         help="'auto' (all devices on data axis), 'none' (local), or "
         "'data=N,model=M' axis sizes",
     )
+    # ---- deploy-time AOT serving (predictionio_tpu.workflow.aot;
+    # docs/operations.md AOT runbook). Strictly opt-in: without --aot no
+    # program is exported and training output is byte-identical
+    # (CI-guarded).
+    train.add_argument(
+        "--aot", action="store_true",
+        help="after training, lower + serialize every budgeted serving "
+        "entrypoint per pow2 candidate bucket (jax.export) into "
+        "<basedir>/fleet/aot/<instance>/ and stamp the artifact set into "
+        "the fleet model registry — `pio deploy --aot` replicas then boot "
+        "by deserializing instead of compiling (zero serve-time "
+        "compiles; docs/operations.md)",
+    )
+    train.add_argument(
+        "--compilation-cache-dir", default=None, metavar="DIR",
+        help="persistent XLA compilation cache directory shared across "
+        "replicas/hosts — the tier-2 fallback when AOT artifacts are "
+        "missing or fingerprint-stale (default: "
+        "$PIO_COMPILATION_CACHE_DIR or <basedir>/jax_cache; '0' "
+        "disables)",
+    )
 
     def add_ssl_flags(sp):
         sp.add_argument(
@@ -379,6 +400,29 @@ def build_parser() -> argparse.ArgumentParser:
         "(catalog/S/4 bytes per device), --pin-model, --ann and "
         "--online (touched rows re-quantize on fold-in); /stats.json "
         "grows a 'quant' section (docs/serving.md)",
+    )
+    # ---- deploy-time AOT serving (predictionio_tpu.workflow.aot;
+    # docs/operations.md AOT runbook). Strictly opt-in: without --aot no
+    # artifact is read and serving is byte-identical (CI-guarded).
+    deploy.add_argument(
+        "--aot", action="store_true",
+        help="boot by deserializing the instance's `pio train --aot` "
+        "exported programs instead of compiling: fingerprint-checked "
+        "(jaxlib/backend/shape-bucket), warmed before the first query, "
+        "ZERO serve-time compiles. A missing/stale/corrupt artifact set "
+        "falls back LOUDLY to the persistent compilation cache (tier 2, "
+        "--compilation-cache-dir) and then plain JIT (tier 3) — results "
+        "stay bit-identical on every tier; implies --pin-model; "
+        "/stats.json grows an 'aot' section with serveTimeCompiles "
+        "(docs/operations.md)",
+    )
+    deploy.add_argument(
+        "--compilation-cache-dir", default=None, metavar="DIR",
+        help="persistent XLA compilation cache directory shared across "
+        "replicas/hosts — the tier-2 fallback when AOT artifacts are "
+        "missing or fingerprint-stale (default: "
+        "$PIO_COMPILATION_CACHE_DIR or <basedir>/jax_cache; '0' "
+        "disables)",
     )
     # ---- approximate retrieval (predictionio_tpu.ops.ivf; docs/serving.md).
     # Strictly opt-in: without --ann every query scores the exact path.
@@ -734,6 +778,14 @@ def build_parser() -> argparse.ArgumentParser:
     )
     cs.add_argument("--seed", type=int, default=0, help="workload RNG seed")
     cs.add_argument(
+        "--aot", action="store_true",
+        help="run the drill AOT-on: `pio train --aot` exports the "
+        "generation's programs, replicas deploy with --aot, and the "
+        "rolling-reload phase additionally asserts ZERO serve-time "
+        "compiles across the full rotation (reload-p99 gated against "
+        "steady-state; docs/operations.md AOT runbook)",
+    )
+    cs.add_argument(
         "--sharded-point", action="store_true",
         help="also measure one fleet whose replicas serve with "
         "--shard-factors (8-way virtual host mesh)",
@@ -927,14 +979,19 @@ def _parse_mesh(spec: str):
     )
 
 
-def _setup_compilation_cache() -> None:
+def _setup_compilation_cache(explicit: str | None = None) -> None:
     """Persist compiled XLA programs across runs: a repeat ``pio train``
     on the same shapes skips the (tens-of-seconds, possibly remote)
-    compile entirely. ``PIO_COMPILATION_CACHE_DIR=0`` disables; default
-    is ``<PIO_FS_BASEDIR>/jax_cache``. Costs no jax import of its own:
-    env vars configure a not-yet-imported jax lazily, and only an
-    already-imported jax (preloaded interpreters) gets config.update."""
-    explicit = os.environ.get("PIO_COMPILATION_CACHE_DIR")
+    compile entirely. Precedence: the ``--compilation-cache-dir`` flag
+    (``explicit``), then ``PIO_COMPILATION_CACHE_DIR``, then the
+    ``<PIO_FS_BASEDIR>/jax_cache`` default; ``0`` disables. Under
+    ``--aot`` this same directory doubles as the tier-2 fallback shared
+    across replicas (docs/operations.md AOT runbook). Costs no jax
+    import of its own: env vars configure a not-yet-imported jax lazily,
+    and only an already-imported jax (preloaded interpreters) gets
+    config.update."""
+    if explicit is None:
+        explicit = os.environ.get("PIO_COMPILATION_CACHE_DIR")
     if explicit == "0":
         return
     if explicit:
@@ -959,6 +1016,62 @@ def _setup_compilation_cache() -> None:
         # jax reads these at import; operator-set JAX_* values win
         os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", cache_dir)
         os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1.0")
+
+
+def _train_aot_export(variant, ctx, instance) -> None:
+    """``pio train --aot``: lower + serialize the just-trained
+    instance's serving programs (workflow/aot.py) and stamp the
+    artifact set into the fleet model registry beside the generation.
+
+    The instance is re-hydrated exactly the way ``pio deploy`` will
+    (``prepare_deploy`` over the stored blob), so what is exported is
+    what will serve. A failed export never fails the train — artifacts
+    are an optimization and deploy falls back loudly to tier 2/3."""
+    from predictionio_tpu.data.storage import Storage
+    from predictionio_tpu.fleet.registry import ModelRegistry
+    from predictionio_tpu.workflow import aot
+
+    engine = variant.build_engine()
+    engine_params = engine.params_from_json(variant.raw)
+    model = Storage.get_model_data_models().get(instance.id)
+    if model is None:
+        print(
+            "WARNING: --aot: no model blob stored for this instance; "
+            "nothing to export",
+            file=sys.stderr,
+        )
+        return
+    _, pairs = engine.prepare_deploy(
+        ctx, engine_params, instance.id, model.models
+    )
+    base_dir = Storage.base_dir()
+    root = os.path.join(base_dir, "fleet", "aot")
+    manifest = aot.export_instance(pairs, instance.id, root)
+    if manifest is None:
+        print(
+            "WARNING: --aot: no algorithm exported a serving program "
+            "(algorithms without the aot_export_for_serving hook "
+            "contribute nothing); `pio deploy --aot` will fall back to "
+            "tier 2/3",
+            file=sys.stderr,
+        )
+        return
+    total = sum(int(e.get("bytes", 0)) for e in manifest.get("entries", []))
+    record = ModelRegistry(os.path.join(base_dir, "fleet")).publish(
+        instance.id,
+        meta={"publisher": "train --aot"},
+        artifacts={
+            "dir": aot.artifact_dir(root, instance.id),
+            "programs": len(manifest.get("entries", [])),
+            "bytes": total,
+            "fingerprint": manifest.get("fingerprint", {}),
+        },
+    )
+    print(
+        f"AOT export: {len(manifest.get('entries', []))} programs "
+        f"({total} bytes) for instance {instance.id} "
+        f"(fleet generation {record.generation})"
+    )
 
 
 def _replica_argv(args, replica_id: str, announce_dir: str) -> list[str]:
@@ -1354,8 +1467,10 @@ def main(argv: list[str] | None = None) -> int:
         import jax
 
         jax.config.update("jax_platforms", platform_override)
-    _setup_compilation_cache()
     args = build_parser().parse_args(argv)
+    _setup_compilation_cache(
+        explicit=getattr(args, "compilation_cache_dir", None)
+    )
     cmd = args.command
     try:
         if cmd == "version":
@@ -1415,6 +1530,10 @@ def main(argv: list[str] | None = None) -> int:
                     warm_start=args.warm_start,
                 ),
             )
+            if args.aot:
+                # lazy: without --aot no AOT module is imported and the
+                # train output is byte-identical (CI-guarded)
+                _train_aot_export(variant, ctx, instance)
             print(f"Training completed. Engine instance: {instance.id}")
         elif cmd == "deploy":
             if (args.replicas and args.replicas > 0) or args.router_only:
@@ -1533,10 +1652,21 @@ def main(argv: list[str] | None = None) -> int:
                     seed=args.explore_seed,
                     reward_event=args.explore_reward_event,
                 )
+            aot = None
+            if args.aot:
+                # lazy: without --aot no AOT module is imported and the
+                # serving path is byte-identical (CI-guarded)
+                from predictionio_tpu.data.storage import Storage
+                from predictionio_tpu.workflow.aot import AotConfig
+
+                aot = AotConfig(
+                    enabled=True,
+                    root=os.path.join(Storage.base_dir(), "fleet", "aot"),
+                )
             service = QueryService(
                 variant, feedback=feedback, instance_id=args.engine_instance_id,
                 batching=batching, cache=cache, ann=ann, online=online,
-                explore=explore, replica_id=args.replica_id,
+                explore=explore, replica_id=args.replica_id, aot=aot,
             )
 
             def wire_stop(server):
@@ -1972,6 +2102,7 @@ def main(argv: list[str] | None = None) -> int:
                     train_events=args.events,
                     seed=args.seed,
                     sharded_point=args.sharded_point,
+                    aot=args.aot,
                     keep_dir=args.keep,
                 )
             )

@@ -44,6 +44,7 @@ __all__ = [
     "release_pairs",
     "build_ann_pairs",
     "bytes_by_dtype",
+    "aot_stats",
     "set_rows",
     "append_rows",
     "swap_side_rows",
@@ -55,7 +56,8 @@ logger = logging.getLogger(__name__)
 
 
 def pin_pairs(
-    pairs: Sequence, shard: bool = False, quantize: str | None = None
+    pairs: Sequence, shard: bool = False, quantize: str | None = None,
+    aot=None, instance_id: str | None = None,
 ) -> tuple[list, int]:
     """Pin every (algorithm, model) pair that supports it.
 
@@ -77,7 +79,18 @@ def pin_pairs(
     so per-device factor bytes drop another ~4x ON TOP of the ``/S``
     from sharding — the two tiers compose multiplicatively. Hooks set
     ``model._pio_bytes_by_dtype`` so :func:`bytes_by_dtype` can report
-    the served per-dtype ledger, not recomputed shape math."""
+    the served per-dtype ledger, not recomputed shape math.
+
+    ``aot`` (a :class:`predictionio_tpu.workflow.aot.AotConfig` with
+    ``enabled``, the ``pio deploy --aot`` tier) makes the replica BOOT
+    BY DESERIALIZING: after pinning, the generation's exported serving
+    programs are loaded from ``<aot.root>/<instance_id>/``, verified
+    (fingerprint + per-blob SHA-256), warmed once, and attached as
+    ``model._pio_aot`` — so the serving path compiles NOTHING at request
+    time. Any load failure logs loudly and serves through the jitted
+    path (tier 2 with the persistent compilation cache, else tier 3),
+    bit-identical by construction; the tier report lands on
+    ``model._pio_aot_report`` for /stats.json."""
     try:
         import jax  # noqa: F401  (availability probe only)
     except Exception:  # pragma: no cover - jax is a hard dep in practice
@@ -117,7 +130,69 @@ def pin_pairs(
                 type(algo).__name__,
             )
         out.append((algo, model))
+    if aot is not None and getattr(aot, "active", False):
+        _attach_aot(out, aot, instance_id)
     return out, total
+
+
+def _attach_aot(pairs: list, aot, instance_id: str | None) -> None:
+    """Load the generation's AOT artifact set ONCE and attach the shared
+    runtime (+ tier report) to every pinned model; failures are loud but
+    never fatal — the models keep serving through their jitted paths."""
+    from predictionio_tpu.workflow import aot as aot_mod
+
+    if not instance_id or not aot.root:
+        logger.warning(
+            "--aot requested but no engine instance id / artifact root "
+            "is known; serving through the JIT path"
+        )
+        return
+    try:
+        runtime, report = aot_mod.load_runtime(instance_id, aot.root)
+    except Exception as e:  # pragma: no cover - load_runtime reports itself
+        runtime, report = None, {
+            "tier": aot_mod.fallback_tier(),
+            "instance": instance_id,
+            "loaded": 0,
+            "problems": [f"{type(e).__name__}: {e}"],
+        }
+        logger.exception("AOT artifact load raised; serving via JIT")
+    for algo, model in pairs:
+        if getattr(model, "_pio_pinned", False):
+            if runtime is not None:
+                model._pio_aot = runtime
+            model._pio_aot_report = report
+            # warm the engine's eager GLUE ops too (the row gather
+            # feeding the exported programs): jax caches eager-op
+            # executables by shape, so one warm call at boot is the
+            # difference between "zero serve-time compiles" and two
+            # first-query compiles the witness would flag (duck-typed,
+            # like the pin/shard hooks)
+            warm = getattr(algo, "aot_warm_serving", None)
+            if warm is not None and runtime is not None:
+                try:
+                    warm(model)
+                except Exception as e:  # noqa: BLE001 - warm is advisory
+                    logger.warning("AOT glue warm-up failed: %s", e)
+
+
+def aot_stats(pairs: Sequence) -> dict | None:
+    """The ``aot`` block of ``/stats.json``: the load-time tier report
+    joined with the live runtime counters (hits/misses/disabled), or
+    ``None`` when no served model carries AOT state."""
+    report = None
+    runtime = None
+    for _, model in pairs:
+        if report is None:
+            report = getattr(model, "_pio_aot_report", None)
+        if runtime is None:
+            runtime = getattr(model, "_pio_aot", None)
+    if report is None and runtime is None:
+        return None
+    out = dict(report or {})
+    if runtime is not None:
+        out.update(runtime.stats())
+    return out
 
 
 def bytes_by_dtype(pairs: Sequence) -> dict:

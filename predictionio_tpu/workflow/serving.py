@@ -133,6 +133,7 @@ class QueryService:
         online=None,
         explore=None,
         replica_id: str | None = None,
+        aot=None,
     ):
         self.variant = variant
         #: fleet identity (``pio deploy --replica-id``, set by the fleet
@@ -193,6 +194,23 @@ class QueryService:
         # config) leaves /queries.json on the exact prior code path. Built
         # BEFORE reload() so the pin-model tier applies to the first load.
         self.cache_config = cache if cache is not None and cache.enabled else None
+        # deploy-time AOT serving (pio deploy --aot; workflow/aot.py).
+        # Strictly opt-in: aot=None (or a disabled config) never imports
+        # workflow.aot and leaves every query on the exact prior code
+        # path (CI-guarded like batching/caching/ann/online). When on,
+        # reload() boots by DESERIALIZING the generation's exported
+        # serving programs, and the serve-time compile counter below
+        # proves the request path compiles nothing after boot.
+        self.aot_config = (
+            aot if aot is not None and getattr(aot, "active", False) else None
+        )
+        self._serve_compiles = None
+        if self.aot_config is not None:
+            from predictionio_tpu.analysis.jit_witness import (
+                ServeCompileCounter,
+            )
+
+            self._serve_compiles = ServeCompileCounter.install()
         self._cache_stats: CacheStats | None = None
         self._result_cache: ResultCache | None = None
         self._singleflight: Singleflight | None = None
@@ -379,32 +397,48 @@ class QueryService:
             serving, pairs = engine.prepare_deploy(
                 self.ctx, engine_params, instance.id, model.models
             )
-            if self.cache_config is not None and (
-                self.cache_config.pin_model
-                or self.cache_config.shard_factors
-                or self.cache_config.quantize is not None
-            ):
+            if (
+                self.cache_config is not None
+                and (
+                    self.cache_config.pin_model
+                    or self.cache_config.shard_factors
+                    or self.cache_config.quantize is not None
+                )
+            ) or self.aot_config is not None:
                 # device-resident tier: factor state pinned once per model
                 # generation (lazy boundary — serving/ stays jax-free;
                 # docs/performance.md). --shard-factors pins SHARDS per
                 # device instead of replicas so per-device memory scales
                 # as catalog / num_devices; --quantize pins int8 codes +
                 # per-row scales for another ~4x on top (docs/serving.md).
+                # --aot (which implies pinning) additionally boots by
+                # deserializing the generation's exported programs, so
+                # the request path compiles nothing (workflow/aot.py).
                 from predictionio_tpu.workflow import device_state
 
                 pairs, bytes_pinned = device_state.pin_pairs(
                     pairs,
-                    shard=self.cache_config.shard_factors,
-                    quantize=self.cache_config.quantize,
+                    shard=(
+                        self.cache_config is not None
+                        and self.cache_config.shard_factors
+                    ),
+                    quantize=(
+                        self.cache_config.quantize
+                        if self.cache_config is not None
+                        else None
+                    ),
+                    aot=self.aot_config,
+                    instance_id=instance.id,
                 )
-                self._cache_stats.set_gauge("bytes_pinned", bytes_pinned)
-                self._cache_stats.set_gauge(
-                    "bytes_by_dtype", device_state.bytes_by_dtype(pairs)
-                )
-                if self.cache_config.shard_factors:
+                if self._cache_stats is not None:
+                    self._cache_stats.set_gauge("bytes_pinned", bytes_pinned)
                     self._cache_stats.set_gauge(
-                        "factor_shards", device_state.shard_count(pairs)
+                        "bytes_by_dtype", device_state.bytes_by_dtype(pairs)
                     )
+                    if self.cache_config.shard_factors:
+                        self._cache_stats.set_gauge(
+                            "factor_shards", device_state.shard_count(pairs)
+                        )
             if self.ann_config is not None:
                 # clustered-retrieval tier: IVF index built once per
                 # model generation behind the same lazy jax boundary;
@@ -475,6 +509,7 @@ class QueryService:
                     )
                 )
                 or self.ann_config is not None
+                or self.aot_config is not None
             )
         ):
             # free the superseded generation's device buffers — pinned
@@ -486,6 +521,12 @@ class QueryService:
             from predictionio_tpu.workflow import device_state
 
             device_state.release_pairs(old_pairs)
+        if self._serve_compiles is not None:
+            # everything compiled so far this reload was BOOT work
+            # (deserialize warm-ups, or tier-2/3 fallback compiles);
+            # compiles counted from here on are serve-time — the number
+            # the --aot contract asserts stays ZERO
+            self._serve_compiles.mark_boot_complete()
         logger.info(
             "Loaded engine instance %s (generation %d)", instance.id, generation
         )
@@ -936,6 +977,7 @@ class QueryService:
                 else {}
             ),
             "ann": self.ann_config is not None,
+            "aot": self.aot_config is not None,
             "online": self.online is not None,
             "explore": (
                 self.explore_config.policy
@@ -1023,6 +1065,24 @@ class QueryService:
                     if (rt := getattr(model, "_pio_quant", None)) is not None
                 ],
             }
+        if self.aot_config is not None:
+            # AOT-serving decomposition (docs/operations.md): which tier
+            # the boot landed on (1 = deserialized artifacts, 2 =
+            # persistent-cache fallback, 3 = plain JIT), program/hit
+            # counters, and the serve-time compile count the --aot
+            # contract asserts stays ZERO after boot
+            from predictionio_tpu.workflow import device_state
+
+            with self._lock:
+                a_pairs = list(self._algo_model_pairs)
+            aot_block = device_state.aot_stats(a_pairs) or {
+                "tier": None, "loaded": 0,
+            }
+            if self._serve_compiles is not None:
+                aot_block["serveTimeCompiles"] = (
+                    self._serve_compiles.serve_time_compiles()
+                )
+            out["aot"] = aot_block
         if self.ann_config is not None:
             # approximate-retrieval decomposition (docs/serving.md):
             # effective nlist/nprobe plus, per built index, clusters
