@@ -67,6 +67,14 @@ _UBIQUITOUS_METHODS = frozenset(
     }
 )
 
+#: may-call alternatives a duck-typed dispatch fans out to before the
+#: resolver gives up as too ambiguous. Sized to the deepest real
+#: wrapper stack: five storage classes define ``tail_follow`` (columnar
+#: driver + client wrapper + partitioned store + its per-partition view
+#: + the replicated store) and the runtime lock witness flags analyzer
+#: gaps the moment an over-tight bound drops that chain
+_DUCK_MAX = 6
+
 
 def module_name(rel_path: str) -> str:
     """``predictionio_tpu/serving/batcher.py`` ->
@@ -568,9 +576,18 @@ class _Resolver:
                 return (target,), None
             # self.<hook>() with no such method: a duck-typed injected
             # callable — may-call every method of that name in-package
-            return tuple(self.graph.methods_named(attr))[:4], None
+            return tuple(self.graph.methods_named(attr))[:_DUCK_MAX], None
         if isinstance(func, ast.Attribute):
             base = func.value
+            if isinstance(base, ast.Subscript):
+                # container element dispatch — `self.followers[i].poll()`
+                # (or through a bare alias): _annotation_name already
+                # collapses a `list[TailFollower]` attr annotation to the
+                # element class, so the subscripted call resolves exactly
+                # like the unsubscripted spelling. Without this the
+                # per-partition follower fan-out dropped the whole
+                # runner->follower->store lock chain (runtime witness gap)
+                base = base.value
             # obj.method() with a known obj type
             base_cls: str | None = None
             if isinstance(base, ast.Name):
@@ -637,7 +654,7 @@ class _Resolver:
                 own = self.graph.classes.get(f"{fi.module}.{fi.cls}")
                 if own is not None and battr not in own.attr_foreign:
                     hits = self.graph.methods_named(func.attr)
-                    if 1 <= len(hits) <= 4:
+                    if 1 <= len(hits) <= _DUCK_MAX:
                         return tuple(hits), None
             return (), None
         if isinstance(func, ast.Name):

@@ -269,6 +269,71 @@ def check_direct_write_final_path(ctx: FileContext) -> Iterator[Finding]:
             break
 
 
+#: call-name fragments that count as replicating data toward a quorum
+#: member (the mirror/append half of an ack protocol)
+_MIRROR_FRAGMENTS = ("mirror", "append", "insert", "ingest", "write")
+
+
+def _is_quorum_fn(name: str) -> bool:
+    """Functions whose name claims quorum/ack semantics. Exact word
+    parts, not substrings — ``rollback``/``fallback``/``pack`` must not
+    match."""
+    parts = name.lower().strip("_").split("_")
+    return "quorum" in parts or "ack" in parts or "acked" in parts
+
+
+@rule(
+    "PIO505",
+    "quorum-ack-before-fsync",
+    "a quorum-ack function returns after replicating data with no fsync "
+    "between the replication call and the return",
+)
+def check_quorum_ack_before_fsync(ctx: FileContext) -> Iterator[Finding]:
+    """The replicated-append contract (``data/storage/replication.py``):
+    an ack may only count a replica once that replica's bytes are
+    fsync-durable — so in any function that *names itself* an ack/quorum
+    step, every ``return`` must be preceded, between it and the last
+    mirror/append-ish call, by an fsync-ish call. A return that follows
+    a mirror with no fsync in between is an ack of page-cache bytes: a
+    crash on the replica un-acknowledges an acknowledged write."""
+    if not ctx.rel_path.startswith(_PIO403_PREFIX):
+        return  # the quorum protocol lives on the storage surface only
+    for fn, scan in _protocol_functions(ctx):
+        if not _is_quorum_fn(fn.name):
+            continue
+        mirrors = [
+            node.lineno
+            for node in ast.walk(fn)
+            if isinstance(node, ast.Call)
+            and any(
+                f in _call_name(ctx, node).lower()
+                for f in _MIRROR_FRAGMENTS
+            )
+        ]
+        if not mirrors:
+            continue
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Return):
+                continue
+            last_mirror = max(
+                (ln for ln in mirrors if ln < node.lineno), default=None
+            )
+            if last_mirror is None:
+                continue  # return before any replication: nothing acked
+            if any(last_mirror < ln <= node.lineno for ln in scan.fsyncs):
+                continue
+            yield ctx.finding(
+                "PIO505",
+                node,
+                "quorum ack returns after a mirror/append with no fsync "
+                "between them — the Q-th copy is page-cache only, so a "
+                "replica crash silently un-acks an acknowledged write; "
+                "fsync the replica's stream before counting it toward "
+                "the quorum",
+            )
+            break  # one finding per function: the fix is one barrier
+
+
 @rule(
     "PIO504",
     "truncate-live-file",

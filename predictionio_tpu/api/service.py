@@ -636,6 +636,29 @@ class EventService:
         payload["bulk"] = self.bulk_stats()
         if self.compaction_scheduler is not None:
             payload["compaction"] = self.compaction_scheduler.to_json()
+        le = Storage.get_l_events()
+        part_count = int(getattr(le, "partition_count", 1) or 1)
+        if part_count > 1:
+            # partitioned store: per-partition stream stats so a wedged
+            # or lagging partition is visible, not averaged away
+            section: dict = {"count": part_count}
+            per_part = getattr(le, "stream_stats_partitioned", None)
+            if callable(per_part):
+                try:
+                    section["streams"] = per_part()
+                except Exception as e:
+                    section["error"] = str(e)[:200]
+            payload["partitions"] = section
+        health = getattr(le, "replication_health", None)
+        if callable(health):
+            try:
+                rep = health()
+            except Exception as e:
+                rep = [{"error": str(e)[:200]}]
+            if rep is not None:
+                # per-partition replication lag + quorum — the loud
+                # degraded-mode surface the durability story promises
+                payload["replication"] = rep
         return Response(200, payload)
 
     def webhook(
@@ -678,10 +701,18 @@ class EventService:
         from predictionio_tpu.api.health import (
             events_check,
             readiness_report,
+            replication_check,
             storage_check,
         )
 
-        return readiness_report(storage=storage_check(), events=events_check())
+        checks = {"storage": storage_check(), "events": events_check()}
+        rep = replication_check()
+        if rep is not None:
+            # replicated stores degrade /readyz on quorum loss — a 503
+            # here is the signal that acked-append guarantees cannot
+            # currently be met on some partition
+            checks["replication"] = rep
+        return readiness_report(**checks)
 
     # ------------------------------------------------------------ dispatch
     def dispatch(

@@ -86,6 +86,29 @@ class EventChunk:
     def __len__(self) -> int:
         return len(self.event)
 
+    def take(self, rows) -> "EventChunk":
+        """Row-subset copy (fancy-indexed) — the unit the partitioned
+        store routes to one partition and the replication layer mirrors
+        to a replica. ``rows`` is any integer index sequence; order is
+        preserved. The whole-chunk case returns ``self`` unsliced."""
+        idx = np.asarray(rows, dtype=np.int64)
+        if idx.shape[0] == len(self.event):
+            return self
+        pick = idx.tolist()
+        return EventChunk(
+            event=[self.event[i] for i in pick],
+            entity_type=[self.entity_type[i] for i in pick],
+            entity_id=[self.entity_id[i] for i in pick],
+            target_entity_type=[self.target_entity_type[i] for i in pick],
+            target_entity_id=[self.target_entity_id[i] for i in pick],
+            t_us=self.t_us[idx],
+            c_us=self.c_us[idx],
+            ids=[self.ids[i] for i in pick],
+            propf={k: v[idx] for k, v in self.propf.items()},
+            propint={k: v[idx] for k, v in self.propint.items()},
+            extra=[self.extra[i] for i in pick],
+        )
+
     def to_events(self) -> list:
         """Decode rows into ``Event`` objects — the universal-driver
         adapter behind ``LEvents.ingest_chunk``'s base default (sqlite,

@@ -709,6 +709,11 @@ class ChunkResult:
     dedup_hits: int = 0  # supplied id answered duplicate
     dedup_misses: int = 0  # supplied id stored fresh
     storage_error: str | None = None
+    #: partitioned appends only: partition -> {"failed": N, "message"} for
+    #: partitions whose append failed. Their rows also appear as per-line
+    #: 500 errors (subject to the MAX_LINE_REPORTS cap); rows on healthy
+    #: partitions in the SAME chunk are stored and acked normally.
+    partition_errors: dict | None = None
 
     def to_json(self) -> dict:
         out = {
@@ -729,6 +734,10 @@ class ChunkResult:
                 out["duplicateLinesTruncated"] = self.duplicates_truncated
         if self.storage_error is not None:
             out["storageError"] = self.storage_error
+        if self.partition_errors:
+            out["partitionErrors"] = {
+                str(p): dict(v) for p, v in sorted(self.partition_errors.items())
+            }
         return out
 
 
@@ -737,6 +746,22 @@ class PipelineError(RuntimeError):
 
 
 _STOP = object()
+
+
+@dataclasses.dataclass
+class _SeqState:
+    """Merge state for one chunk split across partition appenders."""
+
+    base_line: int
+    outcome: ParseOutcome
+    remaining: int
+    stored: int = 0
+    duplicates: int = 0
+    dedup_hits: int = 0
+    dedup_misses: int = 0
+    dup_lines: list = dataclasses.field(default_factory=list)
+    storage_lines: list = dataclasses.field(default_factory=list)
+    partition_errors: dict = dataclasses.field(default_factory=dict)
 
 
 class IngestPipeline:
@@ -755,6 +780,16 @@ class IngestPipeline:
     storage failure fails the CHUNK (its rows report a 500-style
     ``storageError``, matching the batch route's per-slot convention),
     never the stream.
+
+    **Partitioned sinks** (``events.partition_count > 1``): the single
+    appender is replaced by a router thread plus one appender thread per
+    partition, each feeding its own store through a bounded queue — the
+    appends run concurrently and a slow or dead partition never blocks
+    the others. Results still stream back strictly in chunk order (an
+    out-of-order completion buffer re-serializes them), and a failed
+    partition fails only ITS rows: per-line 500 errors naming the
+    partition (plus a ``partitionErrors`` summary), while the same
+    chunk's rows on healthy partitions store and ack normally.
     """
 
     def __init__(
@@ -797,10 +832,37 @@ class IngestPipeline:
         self._parser = threading.Thread(
             target=self._parse_loop, name="pio-ingest-parse", daemon=True
         )
-        self._appender = threading.Thread(
-            target=self._append_loop, name="pio-ingest-append", daemon=True
-        )
         self._parser.start()
+        self._partitions = int(getattr(events, "partition_count", 1) or 1)
+        if self._partitions > 1:
+            # router + per-partition appenders (see class docstring)
+            depth = max(1, queue_depth)
+            self._part_qs: list["queue.Queue"] = [
+                queue.Queue(maxsize=depth) for _ in range(self._partitions)
+            ]
+            self._merge_lock = threading.Lock()
+            self._inflight: dict[int, _SeqState] = {}
+            self._emit_buf: dict[int, ChunkResult] = {}
+            self._emit_next = 0
+            self._parts_live = self._partitions
+            self._appender = threading.Thread(
+                target=self._router_loop, name="pio-ingest-route", daemon=True
+            )
+            self._part_workers = [
+                threading.Thread(
+                    target=self._part_loop,
+                    args=(p,),
+                    name=f"pio-ingest-append-p{p}",
+                    daemon=True,
+                )
+                for p in range(self._partitions)
+            ]
+            for t in self._part_workers:
+                t.start()
+        else:
+            self._appender = threading.Thread(
+                target=self._append_loop, name="pio-ingest-append", daemon=True
+            )
         self._appender.start()
 
     # ------------------------------------------------------------ stages
@@ -825,7 +887,7 @@ class IngestPipeline:
                     )
                 self._append_q.put((seq, base_line, outcome))
         except BaseException as e:  # surfaced to the caller via feed/finish
-            self._failure = e
+            self._failure = e  # piolint: waive=PIO201 -- single atomic write; readers only test non-None
             self._append_q.put(_STOP)
 
     def _append_loop(self) -> None:
@@ -838,7 +900,7 @@ class IngestPipeline:
                 seq, base_line, outcome = item
                 self._result_q.put(self._append_one(seq, base_line, outcome))
         except BaseException as e:
-            self._failure = e
+            self._failure = e  # piolint: waive=PIO201 -- single atomic write; readers only test non-None
             self._result_q.put(_STOP)
 
     def _append_one(
@@ -896,6 +958,158 @@ class IngestPipeline:
                 logger.exception("bulk on_chunk hook failed")
         return result
 
+    # ------------------------------------------------- partitioned appends
+    def _part_put(self, p: int, item) -> None:
+        while True:
+            try:
+                self._part_qs[p].put(item, timeout=1.0)
+                return
+            except queue.Full:
+                if self._failure is not None:
+                    raise PipelineError(
+                        f"ingest pipeline stage died: {self._failure!r}"
+                    ) from self._failure
+
+    def _router_loop(self) -> None:
+        """Split each parsed chunk by entity hash and fan the row groups
+        out to the per-partition appender queues. Serial and cheap (one
+        crc32 pass per chunk) — the appends themselves are what
+        parallelize."""
+        try:
+            while True:
+                item = self._append_q.get()
+                if item is _STOP:
+                    for q_ in self._part_qs:
+                        q_.put(_STOP)
+                    return
+                seq, base_line, outcome = item
+                chunk = outcome.chunk
+                groups: dict[int, list] = {}
+                if len(chunk):
+                    parts = self._events.partition_rows(chunk)
+                    for p in np.unique(parts).tolist():
+                        groups[int(p)] = np.nonzero(parts == p)[0].tolist()
+                state = _SeqState(
+                    base_line=base_line, outcome=outcome,
+                    remaining=len(groups),
+                )
+                with self._merge_lock:
+                    self._inflight[seq] = state
+                if not groups:
+                    self._finalize_seq(seq)
+                    continue
+                for p, rows in sorted(groups.items()):
+                    self._part_put(p, (seq, rows))
+        except BaseException as e:
+            self._failure = e  # piolint: waive=PIO201 -- single atomic write; readers only test non-None
+            for q_ in self._part_qs:
+                try:
+                    q_.put_nowait(_STOP)
+                except queue.Full:
+                    pass
+            self._result_q.put(_STOP)
+
+    def _part_loop(self, p: int) -> None:
+        """Partition ``p``'s appender: exactly one thread ever drives
+        partition ``p``'s store, so per-partition append order (and the
+        columnar tail's single-writer assumption) is preserved while P
+        appenders run concurrently."""
+        try:
+            while True:
+                item = self._part_qs[p].get()
+                if item is _STOP:
+                    with self._merge_lock:
+                        self._parts_live -= 1
+                        last = self._parts_live == 0
+                    if last:
+                        self._result_q.put(_STOP)
+                    return
+                seq, rows = item
+                with self._merge_lock:
+                    state = self._inflight[seq]
+                sub = state.outcome.chunk.take(rows)
+                error = None
+                try:
+                    results = self._events.ingest_chunk_partition(
+                        sub, self._app_id, self._channel_id, p
+                    )
+                except Exception as e:
+                    # partition-scoped failure: ONLY this partition's rows
+                    # fail (per-line 500s naming the partition); the rest
+                    # of the chunk proceeds on the other appenders
+                    logger.exception("partition %d chunk append failed", p)
+                    error = f"Storage error: partition {p}: rows were not stored."
+                    results = None
+                with self._merge_lock:
+                    if results is None:
+                        state.partition_errors[p] = {
+                            "failed": len(rows), "message": error,
+                        }
+                        state.storage_lines.extend(
+                            _err(state.outcome.row_lines[i], error, status=500)
+                            for i in rows
+                        )
+                    else:
+                        for i, (_, dup) in zip(rows, results):
+                            if dup:
+                                state.duplicates += 1
+                                state.dup_lines.append(
+                                    state.outcome.row_lines[i]
+                                )
+                                if state.outcome.id_supplied[i]:
+                                    state.dedup_hits += 1
+                            else:
+                                state.stored += 1
+                                if state.outcome.id_supplied[i]:
+                                    state.dedup_misses += 1
+                    state.remaining -= 1
+                    done = state.remaining == 0
+                if done:
+                    self._finalize_seq(seq)
+        except BaseException as e:
+            self._failure = e  # piolint: waive=PIO201 -- single atomic write; readers only test non-None
+            self._result_q.put(_STOP)
+
+    def _finalize_seq(self, seq: int) -> None:
+        """Assemble the merged ChunkResult and emit it — plus any
+        buffered successors — strictly in sequence order."""
+        with self._merge_lock:
+            state = self._inflight.pop(seq)
+        outcome = state.outcome
+        errors = outcome.errors
+        if state.storage_lines:
+            errors = sorted(
+                errors + state.storage_lines, key=lambda e: e["line"]
+            )
+        state.dup_lines.sort()
+        result = ChunkResult(
+            seq=seq,
+            line_start=state.base_line,
+            received=outcome.received,
+            stored=state.stored,
+            duplicates=state.duplicates,
+            invalid=len(outcome.errors),
+            errors=errors[:MAX_LINE_REPORTS],
+            duplicate_lines=state.dup_lines[:MAX_LINE_REPORTS],
+            errors_truncated=max(0, len(errors) - MAX_LINE_REPORTS),
+            duplicates_truncated=max(
+                0, len(state.dup_lines) - MAX_LINE_REPORTS
+            ),
+            dedup_hits=state.dedup_hits,
+            dedup_misses=state.dedup_misses,
+            partition_errors=state.partition_errors or None,
+        )
+        if self._on_chunk is not None:
+            try:
+                self._on_chunk(result)
+            except Exception:
+                logger.exception("bulk on_chunk hook failed")
+        with self._merge_lock:
+            self._emit_buf[seq] = result
+            while self._emit_next in self._emit_buf:
+                self._result_q.put(self._emit_buf.pop(self._emit_next))
+                self._emit_next += 1
+
     # ----------------------------------------------------------- caller API
     def _check_failure(self) -> None:
         if self._failure is not None:
@@ -903,10 +1117,13 @@ class IngestPipeline:
                 f"ingest pipeline stage died: {self._failure!r}"
             ) from self._failure
 
+    # the _pending/_carry/_seq/_next_line/_closed writes below are all
+    # caller-thread-only stage-0 state; _merge_lock exists solely for the
+    # cross-thread merge buffers (_inflight/_emit_buf/_emit_next/_parts_live)
     def _submit_pending(self) -> None:
-        lines, self._pending = self._pending, []
+        lines, self._pending = self._pending, []  # piolint: waive=PIO201 -- caller-thread stage-0 state
         n = self._pending_lines
-        self._pending_lines = 0
+        self._pending_lines = 0  # piolint: waive=PIO201 -- caller-thread stage-0 state
         item = (self._seq, self._next_line, lines)
         while True:
             # bounded put with a liveness check: if a stage died, raise
@@ -916,8 +1133,8 @@ class IngestPipeline:
                 break
             except queue.Full:
                 self._check_failure()
-        self._seq += 1
-        self._next_line += n
+        self._seq += 1  # piolint: waive=PIO201 -- caller-thread stage-0 state
+        self._next_line += n  # piolint: waive=PIO201 -- caller-thread stage-0 state
 
     def feed(self, data: bytes) -> None:
         """Stage 0: push raw bytes; complete chunks flow downstream.
@@ -926,7 +1143,7 @@ class IngestPipeline:
         self._check_failure()
         if self._closed:
             raise PipelineError("pipeline already finished")
-        lines, self._carry = split_lines(self._carry, data)
+        lines, self._carry = split_lines(self._carry, data)  # piolint: waive=PIO201 -- caller-thread stage-0 state
         if not lines:
             return
         if self._wire == "chunks":
@@ -934,18 +1151,18 @@ class IngestPipeline:
             for line in lines:
                 if line.strip():
                     self._pending.append(line)
-                    self._pending_lines += 1
+                    self._pending_lines += 1  # piolint: waive=PIO201 -- caller-thread stage-0 state
                     self._submit_pending()
             return
         self._pending.extend(lines)
-        self._pending_lines += len(lines)
+        self._pending_lines += len(lines)  # piolint: waive=PIO201 -- caller-thread stage-0 state
         while self._pending_lines >= self._chunk_rows:
             rest = self._pending[self._chunk_rows:]
-            self._pending = self._pending[: self._chunk_rows]
-            self._pending_lines = self._chunk_rows
+            self._pending = self._pending[: self._chunk_rows]  # piolint: waive=PIO201 -- caller-thread stage-0 state
+            self._pending_lines = self._chunk_rows  # piolint: waive=PIO201 -- caller-thread stage-0 state
             self._submit_pending()
-            self._pending = rest
-            self._pending_lines = len(rest)
+            self._pending = rest  # piolint: waive=PIO201 -- caller-thread stage-0 state
+            self._pending_lines = len(rest)  # piolint: waive=PIO201 -- caller-thread stage-0 state
 
     def poll(self) -> list[ChunkResult]:
         """Drain whatever chunk results are ready (non-blocking, in
@@ -977,11 +1194,11 @@ class IngestPipeline:
         ingest), close the stages, and yield the remaining results in
         order. After this, ``summary()`` totals are final."""
         if not self._closed:
-            self._closed = True
+            self._closed = True  # piolint: waive=PIO201 -- caller-thread stage-0 state
             if self._carry.strip():
                 self._pending.append(self._carry)
-                self._pending_lines += 1
-            self._carry = b""
+                self._pending_lines += 1  # piolint: waive=PIO201 -- caller-thread stage-0 state
+            self._carry = b""  # piolint: waive=PIO201 -- caller-thread stage-0 state
             if self._pending:
                 self._submit_pending()
             self._parse_q.put(_STOP)
@@ -1001,9 +1218,14 @@ class IngestPipeline:
     def close(self) -> None:
         """Abandon the stream (error paths): unblock and stop the stage
         threads without waiting for orderly completion."""
-        self._closed = True
-        self._failure = self._failure or PipelineError("pipeline closed")
-        for q in (self._parse_q, self._append_q):
+        self._closed = True  # piolint: waive=PIO201 -- caller-thread stage-0 state
+        self._failure = self._failure or PipelineError(  # piolint: waive=PIO201 -- single atomic write; readers only test non-None
+            "pipeline closed"
+        )
+        queues = [self._parse_q, self._append_q]
+        if self._partitions > 1:
+            queues.extend(self._part_qs)
+        for q in queues:
             try:
                 q.put_nowait(_STOP)
             except queue.Full:

@@ -2087,10 +2087,18 @@ class StorageClient(BaseStorageClient):
         PIO_STORAGE_SOURCES_<ID>_FSYNC=false                 # optional
         PIO_STORAGE_SOURCES_<ID>_DEDUP_WINDOW=100000         # optional
         PIO_STORAGE_SOURCES_<ID>_DEDUP_WARM_BYTES=67108864   # optional
+        PIO_STORAGE_SOURCES_<ID>_PARTITIONS=4                # optional
+        PIO_STORAGE_SOURCES_<ID>_REPLICATION=2               # optional
+        PIO_STORAGE_SOURCES_<ID>_ACK_QUORUM=2                # optional
 
     On open, the driver runs a startup recovery sweep (quarantines orphan
     temp/staging files, replays committed compactions, trims torn tail
     lines) and reports it via :meth:`recovery_report`.
+
+    ``PARTITIONS > 1`` (or ``REPLICATION >= 2``) switches the layout to
+    entity-hash partitioned per-partition stores (see
+    ``data/storage/partitioned.py``); the default path stays byte-for-byte
+    the single-stream layout and never imports the partitioned modules.
     """
 
     def __init__(self, config: StorageClientConfig):
@@ -2106,10 +2114,12 @@ class StorageClient(BaseStorageClient):
         cache_segments = config.properties.get("cache_segments")
         dedup_window = config.properties.get("dedup_window")
         dedup_warm_bytes = config.properties.get("dedup_warm_bytes")
+        partitions = int(config.properties.get("partitions", "1") or "1")
+        replication = int(config.properties.get("replication", "0") or "0")
+        ack_quorum = int(config.properties.get("ack_quorum", "0") or "0")
         base = os.path.join(os.path.expanduser(path), f"{prefix}_events")
         os.makedirs(base, exist_ok=True)
-        self._events = _ColumnarEvents(
-            base, segment_rows, fsync,
+        store_kw = dict(
             cache_segments=(
                 int(cache_segments) if cache_segments is not None else None
             ),
@@ -2120,7 +2130,34 @@ class StorageClient(BaseStorageClient):
                 int(dedup_warm_bytes) if dedup_warm_bytes is not None else None
             ),
         )
-        self._pevents = _ColumnarPEvents(self._events)
+        if partitions > 1 or replication:
+            from predictionio_tpu.data.storage.partitioned import (
+                PartitionedPEvents,
+                open_partitioned,
+            )
+
+            self._events = open_partitioned(
+                base,
+                partitions=partitions,
+                replication=replication,
+                ack_quorum=ack_quorum,
+                segment_rows=segment_rows,
+                fsync=fsync,
+                **store_kw,
+            )
+            self._pevents = PartitionedPEvents(self._events)
+        else:
+            # refuse to open a partitioned layout as a single stream:
+            # routing/dedup state lives per partition, and flattening it
+            # silently would double-store retransmitted events
+            if os.path.exists(os.path.join(base, "partitions.json")):
+                raise StorageError(
+                    f"store at {base} is partitioned (partitions.json "
+                    "present); open it with the same PARTITIONS setting or "
+                    "migrate via pio export/import"
+                )
+            self._events = _ColumnarEvents(base, segment_rows, fsync, **store_kw)
+            self._pevents = _ColumnarPEvents(self._events)
         # startup recovery: a kill -9 can leave orphan temp files, a torn
         # commit marker, or a torn tail line — sweep BEFORE any read or
         # write touches the store, quarantining rather than deleting

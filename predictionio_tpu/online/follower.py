@@ -40,14 +40,23 @@ class FollowerUnsupportedError(RuntimeError):
     columnar driver streams deltas; see docs/operations.md)."""
 
 
-def _state_path(state_dir: str, app_name: str, channel: str | None) -> str:
+def _state_path(
+    state_dir: str, app_name: str, channel: str | None,
+    partition: int | None = None,
+) -> str:
     if not state_dir:
         from predictionio_tpu.data.storage import Storage
 
         state_dir = os.path.join(Storage.base_dir(), "online")
-    # readable prefix + crc so distinct app names never share a cursor
+    # readable prefix + crc so distinct app names never share a cursor;
+    # partitioned stores get one cursor file PER partition follower
+    # (partition=None keeps the pre-partitioning name, so existing
+    # single-stream cursors survive an upgrade)
     name = f"{app_name}\x00{channel or ''}"
     safe = re.sub(r"[^A-Za-z0-9_-]", "_", app_name)
+    if partition is not None:
+        name += f"\x00p{partition}"
+        safe += f"-p{partition}"
     return os.path.join(
         state_dir, f"{safe}-{zlib.crc32(name.encode()):08x}.cursor.json"
     )
@@ -62,11 +71,13 @@ class TailFollower:
         channel: str | None = None,
         state_dir: str = "",
         from_start: bool = False,
+        partition: int | None = None,
     ):
         from predictionio_tpu.data.store import resolve_app
         from predictionio_tpu.data.storage import Storage
 
         self.app_name = app_name
+        self.partition = partition
         self._app_id, self._channel_id = resolve_app(app_name, channel)
         self._pe = Storage.get_p_events()
         if not hasattr(self._pe, "tail_follow"):
@@ -76,7 +87,7 @@ class TailFollower:
                 "driver; docs/operations.md)"
             )
         self._from_start = from_start
-        self._path = _state_path(state_dir, app_name, channel)
+        self._path = _state_path(state_dir, app_name, channel, partition)
         self._lock = threading.Lock()
         self._cursor: dict | None = self._load()
         self._pending: dict | None = None  # advanced but uncommitted
@@ -85,11 +96,18 @@ class TailFollower:
             # ingested between deploy and the daemon's first cycle is
             # new data and must fold — a first-poll anchor would swallow
             # it into the "history" the watermark skips
-            _, self._cursor = self._pe.tail_follow(
-                self._app_id, self._channel_id, cursor=None
-            )
+            _, self._cursor = self._follow(None)
             self._pending = self._cursor
             self.commit()
+
+    def _follow(self, cursor: dict | None):
+        """tail_follow with the partition routed only when set — plain
+        (non-partitioned) stores never see the kwarg."""
+        kw = {} if self.partition is None else {"partition": self.partition}
+        return self._pe.tail_follow(
+            self._app_id, self._channel_id, cursor=cursor,
+            from_start=self._from_start, **kw,
+        )
 
     # ------------------------------------------------------------ persistence
     def _load(self) -> dict | None:
@@ -147,12 +165,7 @@ class TailFollower:
         with self._lock:
             cursor = self._pending if self._pending is not None else self._cursor
             # piolint: waive=PIO211 -- tail_follow can reach os.replace only on first-touch stream creation; every later poll is a pure delta read, and poll/commit must stay serialized under this lock regardless
-            events, new_cursor = self._pe.tail_follow(
-                self._app_id,
-                self._channel_id,
-                cursor=cursor,
-                from_start=self._from_start,
-            )
+            events, new_cursor = self._follow(cursor)
             # only the PENDING cursor advances; the committed cursor
             # moves in commit() so rollback() can re-deliver in-process
             self._pending = new_cursor
@@ -163,12 +176,13 @@ class TailFollower:
         vs the store's current state."""
         with self._lock:
             cursor = dict(self._cursor or {})
+        kw = {} if self.partition is None else {"partition": self.partition}
         state = (
-            self._pe.scan_state(self._app_id, self._channel_id)
+            self._pe.scan_state(self._app_id, self._channel_id, **kw)
             if hasattr(self._pe, "scan_state")
             else {}
         )
-        return {
+        out = {
             "tailLinesConsumed": int(cursor.get("tail_lines", 0)),
             "tailLinesStore": int(state.get("tail_lines", 0)),
             "segmentsConsumed": len(cursor.get("segments", ())),
@@ -179,6 +193,9 @@ class TailFollower:
             # the (line-count) fallback scan (docs/operations.md)
             "tailBytesConsumed": cursor.get("tail_bytes"),
         }
+        if self.partition is not None:
+            out["partition"] = self.partition
+        return out
 
 
 def to_deltas(events, rating_prop: str = "rating") -> list[EventDelta]:

@@ -73,12 +73,30 @@ class OnlineRunner:
                 "--online requires the engine's datasource params to name "
                 "an appName (the stream to follow)"
             )
-        self.follower = TailFollower(
-            app_name,
-            channel=ds_params.get("channelName"),
-            state_dir=config.state_dir,
-            from_start=config.from_start,
+        # one follower per event-store partition: each keeps its own
+        # durable byte-offset cursor and folds concurrently (owner-shard
+        # scatters keep concurrent fold-ins shard-local); a plain store
+        # gets the single partition=None follower with the legacy cursor
+        # filename
+        from predictionio_tpu.data.storage import Storage
+
+        pe = Storage.get_p_events()
+        part_count = int(
+            getattr(getattr(pe, "_e", None), "partition_count", 0)
+            or getattr(pe, "partition_count", 0)
+            or 1
         )
+        self.followers: list[TailFollower] = [
+            TailFollower(
+                app_name,
+                channel=ds_params.get("channelName"),
+                state_dir=config.state_dir,
+                from_start=config.from_start,
+                partition=p,
+            )
+            for p in ([None] if part_count <= 1 else range(part_count))
+        ]
+        self.follower: TailFollower = self.followers[0]
         self._lock = threading.Lock()
         #: serializes whole fold cycles: the daemon cadence and a manual
         #: POST /online/fold.json must not interleave poll/apply/commit
@@ -211,30 +229,21 @@ class OnlineRunner:
                 # the watermark must never advance past a batch that
                 # failed mid-fold (a transient hook/apply error would
                 # otherwise silently skip those events until the next
-                # retrain): drop the pending cursor so the next cycle
+                # retrain): drop the pending cursors so the next cycle
                 # re-delivers the whole batch
-                self.follower.rollback()
+                for f in self.followers:
+                    f.rollback()
                 raise
 
-    def _cycle_locked(self, deadline: float | None = None) -> dict:
+    def _fold_batches(
+        self, pairs, generation: int, deltas, deadline: float | None
+    ) -> tuple[bool, int, str | None]:
+        """Fold one follower's polled deltas in config-sized batches.
+        Returns ``(applied_any, folded, aborted_reason)``. Safe to run
+        concurrently for different partitions: ``apply_online_update``
+        validates the generation under the service lock and the fold-in
+        scatters are shard-local (owner-shard layout, PR 9)."""
         svc = self.service
-        pairs, generation = svc.snapshot_pairs()
-        self._rebind(pairs, generation)
-        events = self.follower.poll()
-        if not events:
-            return {"events": 0, "applied": False}
-        # exploration reward fold-back (ISSUE 16): the same polled batch
-        # feeds the explorer's posterior — reward events are telemetry
-        # for the bandit, not ratings, so they ride beside the fold
-        # pipeline (which ignores non-rating events) rather than in it
-        explorer = getattr(svc, "explorer", None)
-        if explorer is not None:
-            try:
-                explorer.note_reward_events(events)
-            except Exception:
-                logger.exception("explorer reward fold-back failed")
-        deltas = to_deltas(events)
-        newest_us = max((d.t_us for d in deltas), default=0)
         applied_any = False
         folded = 0
         aborted: str | None = None
@@ -282,31 +291,132 @@ class OnlineRunner:
             with self._lock:
                 self._fold_ms.append((time.perf_counter() - t0) * 1e3)
                 del self._fold_ms[:-_SAMPLES]
-        if aborted is not None:
-            # the watermark must never advance past events that were not
-            # applied: drop the poll advance so the next cycle re-delivers
-            # the whole batch against the current generation. Fold-in
-            # re-solves idempotently; the streaming trainer may re-see an
-            # already-trained chunk — its drop-oldest sampling queue
-            # absorbs the repeat.
-            self.follower.rollback()
-            return {
-                "events": len(events),
-                "applied": applied_any,
-                "requeued": True,
-                "reason": aborted,
-            }
-        self.follower.commit()
-        with self._lock:
-            self.folds += 1
-            self.events_seen += len(events)
-            self.events_folded += folded
-            self.last_error = None
-        if applied_any:
+        return applied_any, folded, aborted
+
+    def _cycle_locked(self, deadline: float | None = None) -> dict:
+        svc = self.service
+        pairs, generation = svc.snapshot_pairs()
+        self._rebind(pairs, generation)
+        followers = self.followers
+        if len(followers) == 1:
+            polled = [followers[0].poll()]
+        else:
+            # concurrent polls: each partition's delta read is
+            # independent I/O; a slow partition doesn't delay the rest
+            polled = [None] * len(followers)
+
+            def _poll(i: int) -> None:
+                polled[i] = followers[i].poll()
+
+            threads = [
+                threading.Thread(
+                    target=_poll, args=(i,), name=f"pio-online-poll-p{i}",
+                    daemon=True,
+                )
+                for i in range(len(followers))
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            polled = [p if p is not None else [] for p in polled]
+        total = sum(len(ev) for ev in polled)
+        if not total:
+            return {"events": 0, "applied": False}
+        # exploration reward fold-back (ISSUE 16): the same polled batch
+        # feeds the explorer's posterior — reward events are telemetry
+        # for the bandit, not ratings, so they ride beside the fold
+        # pipeline (which ignores non-rating events) rather than in it
+        explorer = getattr(svc, "explorer", None)
+        if explorer is not None:
+            try:
+                for ev in polled:
+                    if ev:
+                        explorer.note_reward_events(ev)
+            except Exception:
+                logger.exception("explorer reward fold-back failed")
+        all_deltas = [to_deltas(ev) for ev in polled]
+        newest_us = max(
+            (d.t_us for ds in all_deltas for d in ds), default=0
+        )
+        outcomes: list[tuple[bool, int, str | None]] = [None] * len(followers)
+        failures: list[BaseException] = []
+
+        def _fold(i: int) -> None:
+            if not all_deltas[i]:
+                outcomes[i] = (False, 0, None)
+                return
+            try:
+                outcomes[i] = self._fold_batches(
+                    pairs, generation, all_deltas[i], deadline
+                )
+            except BaseException as e:
+                # a partition whose fold died rolls back below and the
+                # exception re-raises after the healthy partitions have
+                # committed — partition isolation without weakening the
+                # "a failed fold never advances the watermark" contract
+                logger.exception("fold failed; requeueing partition batch")
+                outcomes[i] = (False, 0, f"error: {str(e)[:200]}")
+                failures.append(e)
+
+        if len(followers) == 1:
+            _fold(0)
+        else:
+            # one fold worker per partition follower — the concurrency
+            # the owner-shard scatter layout exists to make safe
+            threads = [
+                threading.Thread(
+                    target=_fold, args=(i,), name=f"pio-online-fold-p{i}",
+                    daemon=True,
+                )
+                for i in range(len(followers))
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        applied_any = False
+        folded = 0
+        committed_events = 0
+        requeued = 0
+        reason: str | None = None
+        for f, ev, (applied, n, aborted) in zip(followers, polled, outcomes):
+            applied_any = applied_any or applied
+            folded += n
+            if aborted is not None:
+                # the watermark must never advance past events that were
+                # not applied: drop THIS partition's poll advance so its
+                # next cycle re-delivers the batch. Fold-in re-solves
+                # idempotently; the streaming trainer may re-see an
+                # already-trained chunk — its drop-oldest sampling queue
+                # absorbs the repeat. Other partitions commit normally.
+                f.rollback()
+                requeued += 1
+                reason = reason or aborted
+            else:
+                f.commit()
+                committed_events += len(ev)
+        if committed_events or not requeued:
+            with self._lock:
+                self.folds += 1
+                self.events_seen += committed_events
+                self.events_folded += folded
+                self.last_error = None
+        if applied_any and not requeued:
             # wall-clock event->serving-visible latency: the batch's
             # newest event was just swapped into the live model
             self._record_visible(newest_us)
-        return {"events": len(events), "applied": applied_any}
+        if failures:
+            # propagate the fold failure to the caller (fold_now() raises;
+            # the daemon cycle records it in lastError) — the failed
+            # partitions were rolled back above, the healthy ones already
+            # committed, so re-delivery is scoped to what actually failed
+            raise failures[0]
+        out = {"events": total, "applied": applied_any}
+        if requeued:
+            out["requeued"] = True
+            out["reason"] = reason
+        return out
 
     # ---------------------------------------------------------------- stats
     def stats_json(self) -> dict:
@@ -336,6 +446,8 @@ class OnlineRunner:
             "last": visible[-1] if visible else None,
         }
         out["watermark"] = self.follower.lag()
+        if len(self.followers) > 1:
+            out["watermarks"] = [f.lag() for f in self.followers]
         if trainers:
             out["trainers"] = trainers
         return out

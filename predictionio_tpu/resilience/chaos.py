@@ -52,6 +52,7 @@ import threading
 import time
 import urllib.error
 import urllib.request
+import zlib
 from typing import Any
 
 from predictionio_tpu.resilience.faults import FaultError, FaultInjector
@@ -63,6 +64,7 @@ __all__ = [
     "ServeChaosConfig",
     "run_chaos_fleet",
     "run_chaos_ingest",
+    "run_chaos_partitioned",
     "run_chaos_serve",
 ]
 
@@ -89,6 +91,22 @@ class ChaosConfig:
     #: the same ids until a clean summary). 0 disables the phase.
     bulk_events: int = 1000
     drain_deadline_s: float = 5.0  # the SIGTERM-under-load phase
+    #: >1 adds the partitioned-ingest drill: a columnar store with
+    #: PARTITIONS=P (its own scratch dir — partitioned stores are sealed
+    #: by a marker and never share a path with a plain one), one
+    #: partition's appender chaos-killed mid-bulk-stream (torn tail bytes
+    #: + dead thread — the in-process kill-9 signature), then a real
+    #: whole-server SIGKILL mid-retry. Verdict: zero acked loss, zero
+    #: duplicates, surviving partitions kept storing while the victim
+    #: failed, and the killed partition catches up after restart.
+    partitions: int = 1
+    #: with ``partitions``: replicate each partition across N stores and
+    #: require ``ack_quorum`` fsync-durable copies per ack; the drill then
+    #: also kills one non-leader replica (quorum loss must fail that
+    #: partition's appends loudly and flip /readyz) and asserts replica
+    #: catch-up after restart
+    replication: int = 0
+    ack_quorum: int = 0  # 0 = majority default (replication//2 + 1)
     startup_timeout_s: float = 60.0
     #: overall wall-clock budget; expiry fails the run rather than hanging CI
     total_timeout_s: float = 300.0
@@ -100,6 +118,19 @@ class ChaosConfig:
             raise ValueError("backend must be 'sqlite' or 'columnar'")
         if self.cycles < 1 or self.writers < 1 or self.events_per_writer < 1:
             raise ValueError("cycles, writers, events_per_writer must be >= 1")
+        if self.partitions < 1:
+            raise ValueError("partitions must be >= 1")
+        if self.replication < 0 or self.replication == 1:
+            raise ValueError("replication must be 0 (off) or >= 2")
+        if self.ack_quorum and not self.replication:
+            raise ValueError("ack-quorum requires replication")
+        if self.replication and self.ack_quorum > self.replication:
+            raise ValueError("ack-quorum cannot exceed replication")
+        if self.replication and self.partitions < 2:
+            raise ValueError(
+                "the replicated drill needs partitions >= 2: the replica "
+                "kill must leave OTHER partitions making progress"
+            )
 
 
 def _free_port() -> int:
@@ -632,6 +663,327 @@ def _bulk_phase(env: dict, cfg: ChaosConfig, rng: random.Random,
     return report
 
 
+def _partition_of(entity_type: str, entity_id: str, partitions: int) -> int:
+    """Inline recomputation of the store's crc32 entity routing. The
+    harness is stdlib-only by contract and must not import the storage
+    layer it is auditing — an independent copy of the hash is the point:
+    if the store ever drifts from it, the killed-partition catch-up
+    check fails loudly."""
+    return zlib.crc32(f"{entity_type}\x00{entity_id}".encode()) % partitions
+
+
+def _acked_ids(status: dict, ids: list[str]) -> list[str]:
+    """Event ids one bulk chunk status ACKED: every received line minus
+    the per-line failures. A whole-chunk ``storageError`` or a truncated
+    error list acks nothing — the bar is "no acked event may be lost",
+    so under-counting acks is always the safe direction."""
+    if status.get("storageError") is not None or status.get("errorsTruncated"):
+        return []
+    lo = int(status.get("lineStart", 0))
+    n = int(status.get("received", 0))
+    failed = {
+        int(e.get("line", -1))
+        for e in status.get("errors", ())
+        if int(e.get("status", 0)) >= 400
+    }
+    return [ids[i] for i in range(lo, min(lo + n, len(ids))) if i not in failed]
+
+
+def _get_json(port: int, path: str, timeout_s: float = 5.0):
+    url = f"http://127.0.0.1:{port}{path}?accessKey={_ACCESS_KEY}"
+    try:
+        with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+            return json.loads(resp.read())
+    except Exception:
+        return None
+
+
+def _wait_http_status(
+    port: int, path: str, want: int, timeout_s: float
+) -> bool:
+    """Poll ``path`` until it answers with status ``want``."""
+    deadline = time.monotonic() + timeout_s
+    url = f"http://127.0.0.1:{port}{path}"
+    while time.monotonic() < deadline:
+        try:
+            with urllib.request.urlopen(url, timeout=2) as resp:
+                code = resp.status
+        except urllib.error.HTTPError as e:
+            e.read()
+            code = e.code
+        except Exception:
+            code = 0
+        if code == want:
+            return True
+        time.sleep(0.1)
+    return False
+
+
+def _partitioned_env(base: str, cfg: ChaosConfig) -> tuple[dict, str]:
+    """Columnar EVENTDATA env with PARTITIONS (and, when configured,
+    REPLICATION/ACK_QUORUM) on a drill-private store dir — a partitioned
+    store is sealed by its ``partitions.json`` marker and must never
+    share a path with the plain store the other phases use."""
+    env = _storage_env(base, "columnar")
+    store_dir = os.path.join(base, "events_part")
+    env["PIO_STORAGE_SOURCES_CHAOS_COL_PATH"] = store_dir
+    env["PIO_STORAGE_SOURCES_CHAOS_COL_PARTITIONS"] = str(cfg.partitions)
+    if cfg.replication:
+        env["PIO_STORAGE_SOURCES_CHAOS_COL_REPLICATION"] = str(cfg.replication)
+        env["PIO_STORAGE_SOURCES_CHAOS_COL_ACK_QUORUM"] = str(
+            cfg.ack_quorum or cfg.replication // 2 + 1
+        )
+    return env, store_dir
+
+
+def _partitioned_phase(cfg: ChaosConfig, rng: random.Random, base: str) -> dict:
+    """The kill-one-partition drill (ISSUE 20). One bulk stream against a
+    P-partition store whose busiest partition's appender is chaos-killed
+    mid-stream (torn tail bytes, then every later append on it fails —
+    the in-process kill-9 signature; a thread cannot be SIGKILLed alone),
+    and, with replication on, one non-leader replica of a second
+    partition is killed the same way so its quorum is lost. Then a real
+    whole-server SIGKILL mid-retry, a clean-env restart, and retries of
+    the WHOLE stream with the same ids until a clean summary.
+
+    Verdict fields: zero acked loss, zero duplicates, surviving
+    partitions stored rows in every faulted chunk (no stream-wide
+    stall), the killed partition holds exactly its routed share after
+    recovery, /readyz went degraded while quorum was lost, and every
+    replica reports in-sync at the end."""
+    P = cfg.partitions
+    R = cfg.replication
+    Q = (cfg.ack_quorum or R // 2 + 1) if R else 0
+    env, store_dir = _partitioned_env(base, cfg)
+    n = max(cfg.bulk_events, 400)
+    ids = [f"part-e{i:05d}" for i in range(n)]
+    entities = [f"pu{i % 101}" for i in range(n)]
+    routed = [_partition_of("user", entities[i], P) for i in range(n)]
+    lines = [
+        json.dumps(
+            {
+                "eventId": ids[i],
+                "event": "rate",
+                "entityType": "user",
+                "entityId": entities[i],
+                "targetEntityType": "item",
+                "targetEntityId": f"pi{i % 37}",
+                "properties": {"rating": float(1 + i % 5)},
+            }
+        ).encode() + b"\n"
+        for i in range(n)
+    ]
+    per_part = {p: routed.count(p) for p in range(P)}
+    victim = max(per_part, key=lambda p: per_part[p])
+    fault_env = dict(env)
+    fault_env["PIO_CHAOS_KILL_PARTITION"] = (
+        f"{victim}:{max(1, per_part[victim] * 2 // 5)}"
+    )
+    rvictim = rrep = None
+    if R:
+        others = sorted(
+            (p for p in per_part if p != victim),
+            key=lambda p: -per_part[p],
+        )
+        rvictim = others[0]
+        rrep = (rvictim % R + 1) % R  # first non-leader replica
+        fault_env["PIO_CHAOS_KILL_REPLICA"] = (
+            f"{rvictim}:{rrep}:{max(1, per_part[rvictim] // 3)}"
+        )
+    report: dict[str, Any] = {
+        "partitions": P,
+        "replication": R,
+        "ackQuorum": Q,
+        "events": n,
+        "killedPartition": victim,
+        "killedReplica": f"{rvictim}:{rrep}" if R else None,
+        "rowsPerPartition": {str(p): per_part[p] for p in sorted(per_part)},
+    }
+    port = _free_port()
+    acked: set[str] = set()
+    kills = 0
+    summary = None
+    server = _ServerProc(fault_env, port, extra_args=("--stats",))
+    try:
+        server.wait_ready(cfg.startup_timeout_s)
+        # ---- stream 1: the appender (and replica) faults fire mid-stream
+        attempt = _BulkStreamAttempt(port)
+        try:
+            for lo in range(0, len(lines), 100):
+                attempt.send_piece(b"".join(lines[lo:lo + 100]))
+                time.sleep(0.002)
+            attempt.finish_send()
+            attempt.wait(60.0)
+        finally:
+            attempt.close()
+        fault_seen = False
+        faulted_chunks = 0
+        survivor_chunks = 0
+        failed_lines = 0
+        for st in attempt.statuses:
+            acked.update(_acked_ids(st, ids))
+            perr = st.get("partitionErrors") or {}
+            if perr:
+                fault_seen = True
+                faulted_chunks += 1
+                failed_lines += sum(
+                    int(v.get("failed", 0)) for v in perr.values()
+                )
+                if int(st.get("stored", 0)) + int(st.get("duplicates", 0)) > 0:
+                    survivor_chunks += 1
+        report.update(
+            stream1Completed=attempt.summary is not None,
+            faultFired=fault_seen,
+            faultFailedLines=failed_lines,
+            faultedChunks=faulted_chunks,
+            survivorProgressChunks=survivor_chunks,
+            ackedAfterFault=len(acked),
+        )
+        # ---- degraded-mode surfaces while quorum is lost
+        if R and Q >= 2:
+            report["readyzDegradedSeen"] = _wait_http_status(
+                port, "/readyz", 503, 15.0
+            )
+            stats = _get_json(port, "/stats.json") or {}
+            repl = stats.get("replication") or []
+            report["degradedPartitionsReported"] = sorted(
+                part.get("partition") for part in repl
+                if not part.get("quorumOk")
+            )
+        # ---- a real whole-server SIGKILL mid-retry stream
+        try:
+            attempt2 = _BulkStreamAttempt(port)
+        except OSError:
+            attempt2 = None
+        if attempt2 is not None:
+            try:
+                kill_at = rng.uniform(0.3, 0.7) * len(lines)
+                sent = 0
+                for lo in range(0, len(lines), 100):
+                    attempt2.send_piece(b"".join(lines[lo:lo + 100]))
+                    sent += 100
+                    time.sleep(0.002)
+                    if sent >= kill_at:
+                        server.kill9()
+                        kills += 1
+                        break
+            except OSError:
+                pass  # socket died under the kill: expected
+            finally:
+                attempt2.wait(2.0)
+                for st in attempt2.statuses:
+                    acked.update(_acked_ids(st, ids))
+                attempt2.close()
+        if not kills:
+            server.kill9()
+            kills += 1
+        # ---- clean-env restart (recovery sweep quarantines the torn
+        # tails; replicas reopen healthy) + retry until a clean summary
+        server = _ServerProc(env, port, extra_args=("--stats",))
+        recovery_s = server.wait_ready(cfg.startup_timeout_s)
+        deadline = time.monotonic() + cfg.total_timeout_s / 2
+        attempts = 0
+        while summary is None and time.monotonic() < deadline:
+            attempts += 1
+            try:
+                a = _BulkStreamAttempt(port)
+            except OSError:
+                time.sleep(0.2)
+                continue
+            try:
+                for lo in range(0, len(lines), 100):
+                    a.send_piece(b"".join(lines[lo:lo + 100]))
+                a.finish_send()
+                a.wait(60.0)
+                for st in a.statuses:
+                    acked.update(_acked_ids(st, ids))
+                if a.summary is not None and not any(
+                    st.get("storageError") is not None
+                    or st.get("partitionErrors")
+                    for st in a.statuses
+                ):
+                    summary = a.summary
+            except OSError:
+                pass
+            finally:
+                a.close()
+        # ---- replication catch-up: every partition quorum-ok + in-sync
+        replica_insync = None
+        if R:
+            replica_insync = False
+            wait_until = time.monotonic() + 30.0
+            while time.monotonic() < wait_until:
+                stats = _get_json(port, "/stats.json") or {}
+                repl = stats.get("replication") or []
+                if repl and all(p.get("quorumOk") for p in repl) and all(
+                    lag.get("inSync") and lag.get("healthy")
+                    for p in repl
+                    for lag in (p.get("lag") or {}).values()
+                ):
+                    replica_insync = True
+                    break
+                time.sleep(0.5)
+        # ---- exactly-once + killed-partition catch-up verification
+        stored = _fetch_all_events(port)
+        counts: dict[str, int] = {}
+        for evd in stored:
+            eid = evd.get("eventId") or ""
+            counts[eid] = counts.get(eid, 0) + 1
+        lost = sorted(e for e in acked if counts.get(e, 0) == 0)
+        dups = sorted(
+            e for e in counts if e.startswith("part-") and counts[e] > 1
+        )
+        victim_expected = {ids[i] for i in range(n) if routed[i] == victim}
+        victim_present = sum(
+            1 for e in victim_expected if counts.get(e, 0) == 1
+        )
+        stats = _get_json(port, "/stats.json") or {}
+        report.update(
+            kills=kills,
+            retryAttempts=attempts,
+            completed=summary is not None,
+            summary=summary,
+            recoverySeconds=round(recovery_s, 3),
+            acked=len(acked),
+            ackedLost=len(lost),
+            ackedLostIds=lost[:20],
+            duplicates=len(dups),
+            duplicateIds=dups[:20],
+            killedPartitionExpected=len(victim_expected),
+            killedPartitionPresent=victim_present,
+            killedPartitionCaughtUp=victim_present == len(victim_expected),
+            statsPartitionCount=(stats.get("partitions") or {}).get("count"),
+            replicaCatchUp=replica_insync,
+            unquarantinedTornFiles=len(_unquarantined_torn_files(store_dir)),
+        )
+    finally:
+        server.stop()
+    report["ok"] = bool(
+        report.get("completed")
+        and report.get("stream1Completed")
+        and report.get("faultFired")
+        and report.get("survivorProgressChunks", 0) > 0
+        and report.get("survivorProgressChunks")
+        == report.get("faultedChunks")
+        and kills >= 1
+        and report.get("ackedLost") == 0
+        and report.get("duplicates") == 0
+        and report.get("killedPartitionCaughtUp")
+        and report.get("statsPartitionCount") == P
+        and report.get("unquarantinedTornFiles") == 0
+        and summary is not None
+        and summary.get("stored", 0) + summary.get("duplicates", 0) == n
+        and (
+            not R
+            or (
+                report.get("replicaCatchUp")
+                and (Q < 2 or report.get("readyzDegradedSeen"))
+            )
+        )
+    )
+    return report
+
+
 def _drain_phase(env: dict, cfg: ChaosConfig, rng: random.Random) -> dict:
     """SIGTERM under load: a fresh server with ``--drain-deadline-s``
     gets concurrent writers, then SIGTERM mid-traffic. Verdict: exit 0
@@ -714,6 +1066,27 @@ def _drain_phase(env: dict, cfg: ChaosConfig, rng: random.Random) -> dict:
         "raw500s": raw_500s,
         "drainDeadlineSeconds": cfg.drain_deadline_s,
     }
+
+
+def run_chaos_partitioned(cfg: ChaosConfig) -> dict:
+    """Run ONLY the kill-one-partition drill on a fresh scratch dir (the
+    bench's ``ingest_partitioned.chaos`` subfield and the partitioned CI
+    test call this directly; :func:`run_chaos_ingest` wraps the same
+    phase with the whole-server kill cycles, bulk and drain phases)."""
+    if cfg.partitions <= 1 and not cfg.replication:
+        raise ChaosError("run_chaos_partitioned needs partitions > 1")
+    base = cfg.base_dir or tempfile.mkdtemp(prefix="pio_chaos_part_")
+    os.makedirs(base, exist_ok=True)
+    env = _storage_env(base, "columnar")
+    try:
+        _setup_app(env)
+        report = _partitioned_phase(cfg, random.Random(cfg.seed), base)
+    finally:
+        if not cfg.keep_dir and cfg.base_dir is None:
+            shutil.rmtree(base, ignore_errors=True)
+    if cfg.keep_dir or cfg.base_dir is not None:
+        report["storageDir"] = base
+    return report
 
 
 def run_chaos_ingest(cfg: ChaosConfig) -> dict:
@@ -823,6 +1196,8 @@ def run_chaos_ingest(cfg: ChaosConfig) -> dict:
             server.stop()
     if cfg.bulk_events > 0:
         report["bulk"] = _bulk_phase(env, cfg, rng, base)
+    if cfg.partitions > 1 or cfg.replication:
+        report["partitioned"] = _partitioned_phase(cfg, rng, base)
     report["drain"] = _drain_phase(env, cfg, rng)
     if not cfg.keep_dir and cfg.base_dir is None:
         shutil.rmtree(base, ignore_errors=True)
@@ -838,6 +1213,10 @@ def run_chaos_ingest(cfg: ChaosConfig) -> dict:
         and report.get("tornRequestsStored") == 0
         and report.get("unquarantinedTornFiles") == 0
         and (cfg.bulk_events <= 0 or report.get("bulk", {}).get("ok"))
+        and (
+            (cfg.partitions <= 1 and not cfg.replication)
+            or report.get("partitioned", {}).get("ok")
+        )
         and drain.get("exitCode") == 0
         and drain.get("raw500s") == 0
         and drain.get("withinDeadline")

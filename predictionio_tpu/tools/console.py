@@ -739,6 +739,25 @@ def build_parser() -> argparse.ArgumentParser:
         help="drain deadline for the final SIGTERM-under-load phase",
     )
     ch.add_argument(
+        "--partitions", type=_int_at_least(1), default=1,
+        help=">1 adds the kill-one-partition drill: a columnar store "
+        "with PARTITIONS=P, one partition's appender chaos-killed "
+        "mid-bulk-stream plus a whole-server SIGKILL mid-retry — zero "
+        "acked loss, zero duplicates, surviving partitions never stall, "
+        "the killed partition catches up",
+    )
+    ch.add_argument(
+        "--replication", type=int, default=0,
+        help="with --partitions: replicas per partition (0 off, else "
+        ">= 2); the drill also kills one non-leader replica and asserts "
+        "loud quorum-loss degradation plus replica catch-up",
+    )
+    ch.add_argument(
+        "--ack-quorum", type=int, default=0,
+        help="fsync-durable copies required per ack (default: majority "
+        "of --replication)",
+    )
+    ch.add_argument(
         "--keep", action="store_true",
         help="keep the scratch storage directory for inspection",
     )
@@ -2078,6 +2097,9 @@ def main(argv: list[str] | None = None) -> int:
                     seed=args.seed,
                     bulk_events=args.bulk_events,
                     drain_deadline_s=args.drain_deadline_s,
+                    partitions=args.partitions,
+                    replication=args.replication,
+                    ack_quorum=args.ack_quorum,
                     keep_dir=args.keep,
                 )
             )

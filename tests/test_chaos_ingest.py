@@ -9,7 +9,12 @@ Real subprocesses, real SIGKILL: only the scale is reduced.
 
 import pytest
 
-from predictionio_tpu.resilience.chaos import ChaosConfig, ChaosError, run_chaos_ingest
+from predictionio_tpu.resilience.chaos import (
+    ChaosConfig,
+    ChaosError,
+    run_chaos_ingest,
+    run_chaos_partitioned,
+)
 
 
 def test_chaos_config_validation():
@@ -17,6 +22,16 @@ def test_chaos_config_validation():
         ChaosConfig(backend="hbase")
     with pytest.raises(ValueError, match=">= 1"):
         ChaosConfig(cycles=0)
+    with pytest.raises(ValueError, match="replication"):
+        ChaosConfig(partitions=2, replication=1)  # 1 is a no-op, refuse
+    with pytest.raises(ValueError, match="ack.quorum"):
+        ChaosConfig(partitions=2, ack_quorum=2)  # quorum needs replication
+    with pytest.raises(ValueError, match="ack.quorum"):
+        ChaosConfig(partitions=2, replication=2, ack_quorum=3)
+    with pytest.raises(ValueError, match="partitions"):
+        ChaosConfig(partitions=1, replication=2)  # replication rides P>=2
+    with pytest.raises(ChaosError, match="partitions"):
+        run_chaos_partitioned(ChaosConfig(partitions=1))
 
 
 def test_chaos_ingest_small_run_holds_invariants(tmp_path):
@@ -44,4 +59,43 @@ def test_chaos_ingest_small_run_holds_invariants(tmp_path):
     assert drain["exitCode"] == 0
     assert drain["raw500s"] == 0
     assert drain["withinDeadline"] is True
+    assert report["ok"] is True
+
+
+def test_chaos_partitioned_small_run_holds_invariants(tmp_path):
+    """ISSUE 20: the kill-one-partition drill at P=3 — the victim
+    partition's appender chaos-killed mid-bulk-stream, then the whole
+    server SIGKILLed mid-retry. Zero acked loss, zero duplicates, the
+    surviving partitions stored rows in EVERY faulted chunk, and the
+    killed partition holds exactly its routed share after recovery."""
+    report = run_chaos_partitioned(
+        ChaosConfig(
+            cycles=1,
+            writers=1,
+            events_per_writer=1,
+            backend="columnar",
+            seed=13,
+            bulk_events=240,
+            partitions=3,
+            base_dir=str(tmp_path / "chaos_part"),
+            keep_dir=True,
+        )
+    )
+    assert report["partitions"] == 3
+    assert report["faultFired"] is True
+    assert report["faultedChunks"] > 0
+    # other partitions never stall: every chunk that carried the faulted
+    # partition's per-line 500s ALSO stored rows on healthy partitions
+    assert report["survivorProgressChunks"] == report["faultedChunks"]
+    assert report["kills"] >= 1
+    assert report["completed"] is True
+    assert report["ackedLost"] == 0, report["ackedLostIds"]
+    assert report["duplicates"] == 0, report["duplicateIds"]
+    assert report["killedPartitionCaughtUp"] is True, (
+        f"{report['killedPartitionPresent']}/"
+        f"{report['killedPartitionExpected']} of the killed partition's "
+        "rows present after recovery"
+    )
+    assert report["statsPartitionCount"] == 3
+    assert report["unquarantinedTornFiles"] == 0
     assert report["ok"] is True

@@ -900,7 +900,9 @@ def test_bench_smoke_runs_green():
         # closed loops, the vmapped-sweep timing, the promote drill);
         # round 19 adds aot_serving (~40 s: one train --aot + two deploy
         # boot probes + the in-process rolling-swap phase) and a third
-        # best-of-N repeat in ingest_bulk
+        # best-of-N repeat in ingest_bulk;
+        # round 20 adds ingest_partitioned (~30-60 s: the P axis, one
+        # witnessed P=4 pass, one replicated kill drill)
         env=env,
     )
     assert proc.returncode == 0, (
@@ -1428,6 +1430,45 @@ def test_bench_smoke_runs_green():
     ].get("control", 0), (
         f"post-promote traffic did not collapse onto the winner: {drill}"
     )
+    # partitioned-ingest section (ISSUE 20 acceptance): the bench must
+    # record events/s against a partition-count axis, a witnessed P=4
+    # pass with zero lock-order inversions, and one kill-a-partition +
+    # kill-a-replica chaos drill at replication 2 / ack quorum 2 with
+    # zero acked loss, zero duplicates, and the killed partition caught
+    # up. On a multi-core box P=4 must clear 1.5x over P=1; on a 1-core
+    # box the bench documents the ceiling honestly instead
+    part = detail.get("ingest_partitioned")
+    assert part is not None, "missing bench section 'ingest_partitioned'"
+    assert "error" not in part, f"ingest_partitioned errored: {part}"
+    assert part["events"] > 0
+    assert len(part["points"]) >= 1
+    for pt in part["points"]:
+        assert pt["events_per_sec"] > 0, pt
+        assert pt["stored"] == part["events"], (
+            f"a partition-axis point lost rows: {pt}"
+        )
+    assert part["cpu_count"] >= 1
+    assert part["one_core_ceiling"] or part["scaling_p4"] >= 1.5, (
+        f"multi-core box but P=4 scaling under 1.5x: {part}"
+    )
+    pwit = part["witness"]
+    assert pwit["inversions"] == [], (
+        f"lock-order inversions in the partitioned pipeline: {pwit}"
+    )
+    assert pwit["stored"] > 0
+    assert part["all_stored"] is True
+    pch = part["chaos"]
+    assert pch["faultFired"] is True
+    assert pch["ackedLost"] == 0, pch.get("ackedLostIds")
+    assert pch["duplicates"] == 0, pch.get("duplicateIds")
+    assert pch["killedPartitionCaughtUp"] is True, pch
+    assert pch["replicaCatchUp"] is True, pch
+    assert pch["readyzDegradedSeen"] is True, (
+        f"quorum loss never surfaced on /readyz during the drill: {pch}"
+    )
+    assert pch["unquarantinedTornFiles"] == 0
+    assert pch["ok"] is True, f"partitioned chaos verdict failed: {pch}"
+    assert part["ok"] is True, f"ingest_partitioned verdict failed: {part}"
     # static-analysis section (ISSUE 3): the bench reports piolint rule
     # and finding counts so the guard output stays machine-checked — a
     # tree with non-baselined findings cannot produce a green smoke

@@ -359,6 +359,69 @@ def test_fleet_workload_every_dynamic_edge_is_a_static_edge(tmp_path):
     assert all(r["srcFsynced"] and r["dirFsynced"] for r in renames)
 
 
+def test_partitioned_ingest_workload_has_no_lock_gaps(tmp_path):
+    """ISSUE 20 (satellite 2): drive the partitioned pipeline's P
+    concurrent appender threads AND a quorum-replicated append under the
+    composed witness — the per-partition appender locks, the pipeline's
+    merge lock, and replication's bookkeeping lock are exactly the
+    ordering surface this subsystem added. Zero runtime inversions, zero
+    crosscheck gaps, no new unwaived static cycles."""
+    import json as _json
+
+    from predictionio_tpu.data.ingest import IngestPipeline
+    from predictionio_tpu.data.storage.partitioned import open_partitioned
+    from predictionio_tpu.data.storage.replication import ReplicatedEvents
+
+    payload_nd = b"".join(
+        _json.dumps(
+            {
+                "eventId": f"lw-{i}",
+                "event": "rate",
+                "entityType": "user",
+                "entityId": f"u{i % 41}",
+                "properties": {"rating": 3.0},
+            }
+        ).encode() + b"\n"
+        for i in range(200)
+    )
+
+    def workload():
+        ev = open_partitioned(
+            str(tmp_path / "part"), partitions=4, segment_rows=64,
+            fsync=False,
+        )
+        ev.init(1)
+        pipe = IngestPipeline(ev, app_id=1, chunk_rows=32)
+        pipe.feed(payload_nd)
+        stored = sum(r.stored for r in pipe.finish())
+        ev.close()
+        rep = ReplicatedEvents(
+            [str(tmp_path / f"replica_{r}") for r in range(2)],
+            2, segment_rows=64,
+        )
+        rep.init(1)
+        from tests.test_partitioned_ingest import _ev
+
+        rep.insert_batch_dedup([_ev(f"lwr-{i}", t=i) for i in range(5)], 1)
+        health = rep.replication_health()
+        rep.close()
+        return stored, health
+
+    (stored, health), payload = run_with_lock_witness(workload, waivers=[])
+    assert stored == 200
+    assert health["quorumOk"] is True
+
+    rep = payload["witness"]
+    assert rep["inversions"] == [], rep["inversions"]
+    cc = payload["crosscheck"]
+    assert cc["gaps"] == [], (
+        "the partitioned ingest workload took a lock order the static "
+        "graph lacks:\n" + json.dumps(cc["gaps"], indent=2)
+    )
+    assert cc["unwaivedStaticCycles"] == []
+    assert payload["ok"]
+
+
 # ---------------------------------------------------------------------------
 # CLI: pio lint --witness
 # ---------------------------------------------------------------------------

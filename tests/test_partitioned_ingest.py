@@ -1,0 +1,471 @@
+"""Partitioned, quorum-replicated event streams (ISSUE 20).
+
+The tentpole's correctness surface, unit-sized:
+
+* routing is a stable entity hash (crc32), recomputed here independently
+  of the storage layer's implementation;
+* the default path (``PARTITIONS`` unset / ``1``, no replication) stays
+  byte-identical to the single-stream layout and never imports the
+  partitioned/replication modules (opt-in guard, subprocess probe);
+* the partition count is SEALED: reopening with a different P — or
+  opening partitioned data with the single-stream driver, or
+  partitioning existing single-stream data — is a hard refusal pointing
+  at ``pio export`` → ``pio import``. That refusal IS the dedup story
+  under a changed P: a retransmitted eventId can only be re-routed by an
+  explicit migration, never silently double-stored;
+* retransmitted eventIds dedup across a store restart at the same P;
+* a single partition's storage failure fails only that partition's
+  lines (per-line 500s naming the partition + a ``partitionErrors``
+  summary) while the same chunk's other rows store and the stream
+  completes;
+* quorum-replicated appends ack only after Q fsync-durable copies,
+  report per-replica lag, degrade loudly (QuorumLostError / quorumOk
+  False) when quorum is lost, and catch lagging replicas up from the
+  leader tail;
+* per-partition tail followers are exactly-once across compaction AND a
+  store restart (byte-offset cursors re-anchor, nothing replays).
+
+The end-to-end kill -9 drill lives in ``run_chaos_partitioned``
+(tests/test_chaos_ingest.py runs a compact one; ``bench.py --smoke``
+the full bar).
+"""
+
+import datetime as dt
+import json
+import os
+import subprocess
+import sys
+import time
+import zlib
+
+import pytest
+
+from predictionio_tpu.data.event import DataMap, Event
+from predictionio_tpu.data.ingest import IngestPipeline
+from predictionio_tpu.data.storage.base import StorageClientConfig, StorageError
+from predictionio_tpu.data.storage.columnar import StorageClient
+from predictionio_tpu.data.storage.partitioned import (
+    MARKER_NAME,
+    open_partitioned,
+    partition_of,
+)
+from predictionio_tpu.data.storage.replication import (
+    QuorumLostError,
+    ReplicatedEvents,
+)
+
+UTC = dt.timezone.utc
+APP = 7
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+T0 = dt.datetime(2024, 5, 1, tzinfo=UTC)
+
+
+def _ev(eid, entity="u1", name="rate", t=0):
+    return Event(
+        event=name, entity_type="user", entity_id=entity,
+        target_entity_type="item", target_entity_id="i1",
+        properties=DataMap({"rating": 4.0}),
+        event_time=T0 + dt.timedelta(seconds=t),
+        creation_time=T0 + dt.timedelta(seconds=t),  # deterministic bytes
+        event_id=eid,
+    )
+
+
+def _client(path, **props):
+    merged = {"path": str(path), "segment_rows": "64", **props}
+    return StorageClient(
+        StorageClientConfig("PARTTEST", "columnar", merged)
+    )
+
+
+def _ndjson(events):
+    return b"".join(
+        json.dumps(
+            {
+                "eventId": e.event_id,
+                "event": e.event,
+                "entityType": e.entity_type,
+                "entityId": e.entity_id,
+                "targetEntityType": e.target_entity_type,
+                "targetEntityId": e.target_entity_id,
+                "properties": dict(e.properties),
+                "eventTime": e.event_time.isoformat(),
+            }
+        ).encode() + b"\n"
+        for e in events
+    )
+
+
+# ---------------------------------------------------------------------------
+# Routing
+# ---------------------------------------------------------------------------
+
+
+def test_routing_is_stable_crc32():
+    """The router's hash is pinned: recompute it here from the documented
+    formula so a 'harmless' hash swap (which would silently re-route
+    every entity and break dedup) turns a test red."""
+    for et, ei, p in (
+        ("user", "u1", 4), ("user", "u2", 4), ("item", "i1", 4),
+        ("user", "u1", 7),
+    ):
+        expect = zlib.crc32(f"{et}\x00{ei}".encode("utf-8")) % p
+        assert partition_of(et, ei, p) == expect
+    # every partition is reachable over a modest entity spread
+    hit = {partition_of("user", f"u{i}", 4) for i in range(64)}
+    assert hit == {0, 1, 2, 3}
+
+
+def test_entity_rows_land_on_their_hash_partition(tmp_path):
+    ev = open_partitioned(
+        str(tmp_path / "p"), partitions=4, segment_rows=64, fsync=False
+    )
+    try:
+        ev.init(APP)
+        events = [_ev(f"c-{i}", entity=f"u{i}", t=i) for i in range(40)]
+        ev.insert_batch(events, APP)
+        for e in events:
+            p = partition_of("user", e.entity_id, 4)
+            got = {
+                x.event_id for x in ev.store(p).find(APP, entity_type="user",
+                                                     entity_id=e.entity_id)
+            }
+            assert e.event_id in got
+        # facade-level reads merge all partitions
+        assert len(list(ev.find(APP))) == 40
+    finally:
+        ev.close()
+
+
+# ---------------------------------------------------------------------------
+# Opt-in guard (satellite 5's test half; the bench half is in bench.py)
+# ---------------------------------------------------------------------------
+
+
+def test_partitioned_ingest_defaults_are_opt_in(tmp_path):
+    """ISSUE 20 guard: P=1 + replication off must be the EXACT single
+    stream driver — byte-identical on-disk layout, and the partitioned /
+    replication modules never imported on the default path."""
+    events = [_ev(f"opt-{i}", entity=f"u{i % 5}", t=i) for i in range(30)]
+    trees = {}
+    for name, props in (
+        ("default", {}),
+        ("explicit_p1", {"partitions": "1"}),
+    ):
+        c = _client(tmp_path / name, **props)
+        le = c.get_l_events()
+        le.init(APP)
+        le.insert_batch_dedup(events, APP)
+        base = os.path.join(str(tmp_path / name), "pio_events")
+        tree = {}
+        for root, _dirs, files in os.walk(base):
+            for f in sorted(files):
+                full = os.path.join(root, f)
+                with open(full, "rb") as fh:
+                    tree[os.path.relpath(full, base)] = fh.read()
+        trees[name] = tree
+        close = getattr(le, "close", None)
+        if close:
+            close()
+    assert trees["default"].keys() == trees["explicit_p1"].keys()
+    for rel in trees["default"]:
+        if os.path.basename(rel) == "stream_id":
+            continue  # per-store-instance uuid, random by design
+        assert trees["default"][rel] == trees["explicit_p1"][rel], (
+            f"default vs partitions=1 layout diverged at {rel}"
+        )
+    assert MARKER_NAME not in trees["default"], (
+        "single-stream layout grew a partition marker"
+    )
+    assert any(
+        os.path.basename(rel) == "tail.jsonl" and trees["default"][rel]
+        for rel in trees["default"]
+    ), "comparison is vacuous — no tail bytes landed"
+    # import probe in a clean interpreter: opening + writing through the
+    # default columnar driver must not import the partitioned modules
+    probe = (
+        "import sys, tempfile; "
+        "from predictionio_tpu.data.storage.columnar import StorageClient; "
+        "from predictionio_tpu.data.storage.base import StorageClientConfig; "
+        "c = StorageClient(StorageClientConfig('X', 'columnar', "
+        "{'path': tempfile.mkdtemp()})); "
+        "le = c.get_l_events(); le.init(1); "
+        "bad = [m for m in sys.modules if m in ("
+        "'predictionio_tpu.data.storage.partitioned', "
+        "'predictionio_tpu.data.storage.replication')]; "
+        "sys.exit(1 if bad else 0)"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", probe], cwd=REPO, capture_output=True
+    )
+    assert proc.returncode == 0, proc.stderr.decode()[-500:]
+
+
+# ---------------------------------------------------------------------------
+# The sealed-P refusal story (satellite 3)
+# ---------------------------------------------------------------------------
+
+
+class TestPartitionCountIsSealed:
+    def test_reopen_with_different_p_refuses(self, tmp_path):
+        base = str(tmp_path / "s")
+        ev = open_partitioned(base, partitions=2, segment_rows=64, fsync=False)
+        ev.init(APP)
+        ev.insert_batch([_ev("seal-1")], APP)
+        ev.close()
+        with pytest.raises(StorageError, match="pio export"):
+            open_partitioned(base, partitions=3, segment_rows=64, fsync=False)
+        # the message must say WHY: silent re-partitioning breaks dedup
+        with pytest.raises(StorageError, match="dedup"):
+            open_partitioned(base, partitions=3, segment_rows=64, fsync=False)
+
+    def test_single_stream_driver_refuses_partitioned_layout(self, tmp_path):
+        c = _client(tmp_path / "s", partitions="2")
+        c.get_l_events().init(APP)
+        c.get_l_events().close()
+        with pytest.raises(StorageError, match="partitions.json"):
+            _client(tmp_path / "s")
+
+    def test_partitioning_existing_single_stream_data_refuses(self, tmp_path):
+        c = _client(tmp_path / "s")
+        le = c.get_l_events()
+        le.init(APP)
+        le.insert_batch([_ev("old-1")], APP)
+        base = os.path.join(str(tmp_path / "s"), "pio_events")
+        with pytest.raises(StorageError, match="pio export"):
+            open_partitioned(base, partitions=2, segment_rows=64, fsync=False)
+
+    def test_same_p_reopen_still_dedups_retransmits(self, tmp_path):
+        """The half of the story the refusal protects: at the SAME P a
+        full retransmit (new process, fresh dedup windows) is absorbed —
+        every id routes back to the partition that first stored it."""
+        base = str(tmp_path / "s")
+        events = [_ev(f"rt-{i}", entity=f"u{i}", t=i) for i in range(50)]
+        ev = open_partitioned(base, partitions=4, segment_rows=64, fsync=False)
+        ev.init(APP)
+        first = ev.insert_batch_dedup(events, APP)
+        assert all(dup is False for _eid, dup in first)
+        ev.close()
+        ev = open_partitioned(base, partitions=4, segment_rows=64, fsync=False)
+        try:
+            again = ev.insert_batch_dedup(events, APP)
+            assert all(dup is True for _eid, dup in again), (
+                "retransmit after restart was not fully dedup'd"
+            )
+            assert [eid for eid, _ in again] == [e.event_id for e in events]
+            assert len(list(ev.find(APP))) == 50
+        finally:
+            ev.close()
+
+
+# ---------------------------------------------------------------------------
+# Partition failure isolation (satellite 1)
+# ---------------------------------------------------------------------------
+
+
+def test_single_partition_failure_fails_only_its_lines(tmp_path):
+    ev = open_partitioned(
+        str(tmp_path / "p"), partitions=3, segment_rows=64, fsync=False
+    )
+    try:
+        ev.init(APP)
+        events = [_ev(f"iso-{i}", entity=f"u{i}", t=i) for i in range(60)]
+        victim = partition_of("user", events[0].entity_id, 3)
+        broken = ev.store(victim)
+
+        def _boom(chunk, app_id, channel_id=None):
+            raise OSError("disk gone")
+
+        broken.ingest_chunk = _boom
+        pipe = IngestPipeline(ev, app_id=APP, chunk_rows=20)
+        pipe.feed(_ndjson(events))
+        results = list(pipe.finish())
+        victim_lines = {
+            i for i, e in enumerate(events)
+            if partition_of("user", e.entity_id, 3) == victim
+        }
+        assert victim_lines and len(victim_lines) < 60
+        failed_lines, stored = set(), 0
+        for r in results:
+            stored += r.stored
+            st = r.to_json()
+            for err in st["errors"]:
+                assert err["status"] == 500
+                assert f"partition {victim}" in err["message"]
+                failed_lines.add(err["line"])
+            if st["partitionErrors"]:
+                assert set(st["partitionErrors"]) == {str(victim)}
+                assert "partition" in st["partitionErrors"][str(victim)][
+                    "message"
+                ]
+        # exactly the victim's routed rows failed; every other row stored
+        assert failed_lines == victim_lines
+        assert stored == 60 - len(victim_lines)
+        # results streamed back strictly in chunk order despite the
+        # out-of-order partition completions
+        assert [r.seq for r in results] == sorted(r.seq for r in results)
+        # the healthy partitions actually hold their rows
+        for e in events:
+            p = partition_of("user", e.entity_id, 3)
+            if p != victim:
+                assert ev.get(e.event_id, APP) is not None
+    finally:
+        ev.close()
+
+
+# ---------------------------------------------------------------------------
+# Quorum replication (tentpole's durability half)
+# ---------------------------------------------------------------------------
+
+
+def _replicated(tmp_path, n=3, q=2, leader=0):
+    return ReplicatedEvents(
+        [str(tmp_path / f"replica_{r}") for r in range(n)],
+        q, segment_rows=64, leader=leader,
+    )
+
+
+class TestQuorumReplication:
+    def test_ack_means_q_durable_copies(self, tmp_path):
+        ev = _replicated(tmp_path)
+        try:
+            ev.init(APP)
+            res = ev.insert_batch_dedup(
+                [_ev(f"q-{i}", t=i) for i in range(10)], APP
+            )
+            assert all(dup is False for _eid, dup in res)
+            # leader + the first sync-order replica hold every row NOW
+            # (not eventually): the ack already counted their fsyncs
+            for r in (ev.leader, (ev.leader + 1) % ev.replicas):
+                got = {
+                    e.event_id for e in ev.replica_store(r).find(APP)
+                }
+                assert got == {f"q-{i}" for i in range(10)}
+        finally:
+            ev.close()
+
+    def test_quorum_loss_is_loud_and_reported(self, tmp_path):
+        ev = _replicated(tmp_path, n=3, q=3)
+        try:
+            ev.init(APP)
+            ev.insert_batch([_ev("ql-0")], APP)
+            ev.fail_replica(1)
+            health = ev.replication_health()
+            assert health["quorumOk"] is False
+            assert health["healthy"][1] is False
+            with pytest.raises(QuorumLostError):
+                ev.insert_batch([_ev("ql-1")], APP)
+            # the unacked event may exist on the leader; a client retry
+            # must never double-store once quorum is back
+            with pytest.raises(StorageError):
+                ev.fail_replica(ev.leader)  # leader is not fenceable
+        finally:
+            ev.close()
+
+    def test_catchup_drains_leader_tail_to_followers(self, tmp_path):
+        ev = _replicated(tmp_path, n=3, q=1)  # q=1: no sync mirror at all
+        try:
+            ev.init(APP)
+            # leader-only append (quorum already satisfied by the leader
+            # itself): followers must converge via async tail catch-up
+            ev.insert_batch([_ev(f"cu-{i}", t=i) for i in range(25)], APP)
+            deadline = time.monotonic() + 10
+            want = {f"cu-{i}" for i in range(25)}
+            while time.monotonic() < deadline:
+                lag = ev.replication_health()["lag"]
+                if lag and all(v["inSync"] for v in lag.values()):
+                    break
+                time.sleep(0.05)
+            for r in range(3):
+                if r == ev.leader:
+                    continue
+                got = {e.event_id for e in ev.replica_store(r).find(APP)}
+                assert got == want, f"replica {r} never caught up"
+            # catch-up is dedup'd: no replica holds duplicates
+            for r in range(3):
+                assert len(list(ev.replica_store(r).find(APP))) == 25
+        finally:
+            ev.close()
+
+    def test_leader_rotates_with_partition_index(self, tmp_path):
+        ev = open_partitioned(
+            str(tmp_path / "p"), partitions=4, replication=2,
+            segment_rows=64, fsync=True,
+        )
+        try:
+            assert [s.leader for s in (ev.store(k) for k in range(4))] == [
+                0, 1, 0, 1
+            ]
+            health = ev.replication_health()
+            assert [h["partition"] for h in health] == [0, 1, 2, 3]
+            assert all(h["quorumOk"] for h in health)
+        finally:
+            ev.close()
+
+
+# ---------------------------------------------------------------------------
+# Per-partition followers: exactly-once across compaction + restart
+# ---------------------------------------------------------------------------
+
+
+def test_follower_cursors_survive_compaction_and_restart(tmp_path):
+    base = str(tmp_path / "p")
+    P = 2
+    ev = open_partitioned(base, partitions=P, segment_rows=8, fsync=False)
+    seen = {p: [] for p in range(P)}
+    cursors = {p: None for p in range(P)}
+
+    def _drain(ev):
+        for p in range(P):
+            events, cursors[p] = ev.tail_follow(
+                APP, cursor=cursors[p], from_start=True, partition=p
+            )
+            seen[p].extend(e.event_id for e in events)
+
+    try:
+        ev.init(APP)
+        ev.insert_batch(
+            [_ev(f"f-{i}", entity=f"u{i}", t=i) for i in range(30)], APP
+        )
+        _drain(ev)
+        # compaction moves the tail into segments; the byte-offset
+        # cursor must re-anchor, not replay
+        assert ev.compact(APP) > 0
+        ev.insert_batch(
+            [_ev(f"f-{i}", entity=f"u{i}", t=i) for i in range(30, 45)], APP
+        )
+        _drain(ev)
+    finally:
+        ev.close()
+    # restart: same cursors carried over (as the online runner's durable
+    # per-partition state files do)
+    ev = open_partitioned(base, partitions=P, segment_rows=8, fsync=False)
+    try:
+        ev.insert_batch(
+            [_ev(f"f-{i}", entity=f"u{i}", t=i) for i in range(45, 60)], APP
+        )
+        _drain(ev)
+    finally:
+        ev.close()
+    all_seen = [eid for p in range(P) for eid in seen[p]]
+    assert sorted(all_seen, key=lambda s: int(s.split("-")[1])) == [
+        f"f-{i}" for i in range(60)
+    ], "follower replayed or lost rows across compaction/restart"
+    # each partition's follower saw exactly its routed entities
+    for p in range(P):
+        assert seen[p], f"partition {p} follower saw nothing"
+        for eid in seen[p]:
+            i = int(eid.split("-")[1])
+            assert partition_of("user", f"u{i}", P) == p
+
+
+def test_tail_follow_requires_partition_kwarg_when_partitioned(tmp_path):
+    ev = open_partitioned(
+        str(tmp_path / "p"), partitions=2, segment_rows=64, fsync=False
+    )
+    try:
+        ev.init(APP)
+        with pytest.raises(StorageError, match="partition="):
+            ev.tail_follow(APP)
+    finally:
+        ev.close()

@@ -2228,6 +2228,82 @@ def test_pio504_truncate_live_file():
     assert "PIO504" not in _codes("predictionio_tpu/fleet/x.py", tmpwrite)
 
 
+_PIO505_QUORUM = """\
+import os
+
+class Replicated:
+    def _quorum_ack(self, data):
+        acked = 1
+        for store in self.replicas:
+            store.mirror_rows(data)
+            acked += 1
+        return acked
+"""
+
+
+def test_pio505_quorum_ack_before_fsync():
+    """ISSUE 20: a quorum ack that counts a replica without an fsync
+    between the mirror and the return is acking page-cache bytes — a
+    replica crash silently un-acks an acknowledged write."""
+    assert _codes(
+        "predictionio_tpu/data/storage/x.py", _PIO505_QUORUM
+    ) == ["PIO505"]
+    # an fsync between the mirror and the return satisfies the contract
+    good = _PIO505_QUORUM.replace(
+        "            store.mirror_rows(data)\n",
+        "            store.mirror_rows(data)\n"
+        "            os.fsync(store.fd)\n",
+    )
+    assert _codes("predictionio_tpu/data/storage/x.py", good) == []
+    # a helper-mediated fsync counts (same convention as PIO501): the
+    # real replication module's barrier is self._fsync_stream_replica
+    helper = _PIO505_QUORUM.replace(
+        "            store.mirror_rows(data)\n",
+        "            store.mirror_rows(data)\n"
+        "            self._fsync_stream_replica(store)\n",
+    )
+    assert _codes("predictionio_tpu/data/storage/x.py", helper) == []
+    # scoped to the storage surface: quorum-ish names elsewhere (the
+    # chaos harness's acked-id accounting, say) are not protocol code
+    assert _codes("predictionio_tpu/api/x.py", _PIO505_QUORUM) == []
+
+
+def test_pio505_name_matching_is_word_exact():
+    # rollback/fallback/pack contain 'ack' as a substring, not a word
+    # part — a substring match would flag every rollback helper in the
+    # storage package
+    for name in ("_rollback", "fallback_insert", "pack_rows"):
+        src = _PIO505_QUORUM.replace("_quorum_ack", name)
+        assert _codes("predictionio_tpu/data/storage/x.py", src) == [], name
+    # a return BEFORE any mirror acknowledges nothing; a return after a
+    # mirror-then-fsync is the protocol working
+    early = """\
+import os
+
+class Replicated:
+    def _quorum_ack(self, data):
+        if not self.replicas:
+            return 0
+        self.leader.append_rows(data)
+        os.fsync(self.leader.fd)
+        return 1
+"""
+    assert _codes("predictionio_tpu/data/storage/x.py", early) == []
+
+
+def test_pio505_real_replication_module_is_clean():
+    """The shipped quorum barrier must satisfy its own rule (mirror →
+    _fsync_stream_replica → ack count) with no waiver."""
+    path = os.path.join(
+        REPO, "predictionio_tpu", "data", "storage", "replication.py"
+    )
+    with open(path) as f:
+        src = f.read()
+    found, _ = lint_file("predictionio_tpu/data/storage/replication.py", src)
+    assert [f.code for f in found if f.code == "PIO505"] == []
+    assert "waive=PIO505" not in src
+
+
 # ---------------------------------------------------------------------------
 # callgraph edge cases: decorators, closures, inheritance, aliases,
 # factory attrs, may-call fan-out (ISSUE 18)
